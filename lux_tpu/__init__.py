@@ -1,0 +1,31 @@
+"""lux_tpu — a TPU-native distributed graph-processing framework.
+
+A from-scratch rebuild of the capability set of Lux (Jia et al., VLDB'17;
+reference sources under /root/reference) designed for TPUs:
+
+- vertex programs in two execution models: **pull** (gather-apply over all
+  vertices) and **push** (frontier-driven relaxation with adaptive
+  direction switching), expressed as jitted XLA computations instead of
+  CUDA kernels;
+- **edge-balanced contiguous partitioning** of the vertex space
+  (reference: core/pull_model.inl:108-131) mapped onto a
+  `jax.sharding.Mesh`, with ghost-vertex exchange via ICI collectives
+  (`all_gather`) instead of Legion zero-copy memory;
+- the four reference applications — PageRank, SSSP, Connected Components,
+  Collaborative Filtering — plus the `.lux` binary CSC graph format and
+  an edge-list converter (reference: tools/converter.cc).
+
+Layout:
+    lux_tpu.graph     — .lux format, Graph data model, partitioner, generators
+    lux_tpu.ops       — segment reductions and Pallas kernels (device compute)
+    lux_tpu.parallel  — mesh construction, sharded graph layout, exchange
+    lux_tpu.engine    — pull/push executors, invariant checkers
+    lux_tpu.models    — the applications (vertex programs + CLI drivers)
+    lux_tpu.utils     — config/flags, logging, timing, checkpointing
+    lux_tpu.native    — C++ fast paths for IO (converter, loader, CSR build)
+"""
+
+__version__ = "0.1.0"
+
+from lux_tpu.graph.graph import Graph  # noqa: F401
+from lux_tpu.graph.partition import edge_balanced_bounds  # noqa: F401

@@ -1,0 +1,115 @@
+"""Synthetic graph generators (for tests and benchmarks).
+
+The reference ships no generator — its benchmark graphs (Hollywood, Twitter,
+RMAT27, ... README.md:79-86) are downloaded. We generate R-MAT graphs of the
+same family locally for benchmarking, plus tiny deterministic graphs for
+unit tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph.graph import Graph
+
+
+def rmat_edges(
+    scale: int,
+    ne: int,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    batch: int = 1 << 24,
+):
+    """Yield (src, dst) int64 batches of an R-MAT graph with 2**scale
+    vertices. Vectorized one bit-level at a time; streamed in batches so
+    RMAT27-sized generation stays within memory."""
+    rng = np.random.default_rng(seed)
+    remaining = ne
+    while remaining > 0:
+        n = min(batch, remaining)
+        src = np.zeros(n, dtype=np.int64)
+        dst = np.zeros(n, dtype=np.int64)
+        for _ in range(scale):
+            u = rng.random(n)
+            # Quadrant probs: (0,0)=a, (0,1)=b, (1,0)=c, (1,1)=d.
+            src_bit = u >= a + b
+            dst_bit = ((u >= a) & (u < a + b)) | (u >= a + b + c)
+            src = (src << 1) | src_bit
+            dst = (dst << 1) | dst_bit
+        yield src, dst
+        remaining -= n
+
+
+def rmat(
+    scale: int,
+    edge_factor: int = 16,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    weighted: bool = False,
+    max_weight: int = 100,
+) -> Graph:
+    """R-MAT graph with ``nv = 2**scale`` vertices and ``nv * edge_factor``
+    edges (Graph500 parameters by default; RMAT27 ⇒ scale=27, ef=16)."""
+    nv = 1 << scale
+    ne = nv * edge_factor
+    srcs, dsts = [], []
+    for s, d in rmat_edges(scale, ne, a=a, b=b, c=c, seed=seed):
+        srcs.append(s)
+        dsts.append(d)
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    w = None
+    if weighted:
+        w = np.random.default_rng(seed + 1).integers(
+            1, max_weight + 1, size=ne, dtype=np.int32
+        )
+    return Graph.from_edges(src, dst, nv=nv, weights=w)
+
+
+def gnp(nv: int, ne: int, seed: int = 0, weighted: bool = False) -> Graph:
+    """Uniform random multigraph with exactly ``ne`` directed edges."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, size=ne, dtype=np.int64)
+    dst = rng.integers(0, nv, size=ne, dtype=np.int64)
+    w = rng.integers(1, 101, size=ne, dtype=np.int32) if weighted else None
+    return Graph.from_edges(src, dst, nv=nv, weights=w)
+
+
+def undirected(g: Graph) -> Graph:
+    """Symmetrize: add the reverse of every edge (needed for CC, whose label
+    propagation follows directed edges only — reference components use
+    symmetric inputs)."""
+    dst = g.col_dst
+    src = g.col_src
+    both_src = np.concatenate([src, dst]).astype(np.int64)
+    both_dst = np.concatenate([dst, src]).astype(np.int64)
+    w = None
+    if g.weights is not None:
+        w = np.concatenate([g.weights, g.weights])
+    return Graph.from_edges(both_src, both_dst, nv=g.nv, weights=w)
+
+
+def path_graph(n: int) -> Graph:
+    """0 → 1 → ... → n-1 (directed path, both directions NOT added)."""
+    src = np.arange(n - 1, dtype=np.int64)
+    dst = src + 1
+    return Graph.from_edges(src, dst, nv=n)
+
+
+def star_graph(n: int) -> Graph:
+    """Center 0 with out-edges to 1..n-1."""
+    src = np.zeros(n - 1, dtype=np.int64)
+    dst = np.arange(1, n, dtype=np.int64)
+    return Graph.from_edges(src, dst, nv=n)
+
+
+def cycle_graph(n: int) -> Graph:
+    src = np.arange(n, dtype=np.int64)
+    dst = (src + 1) % n
+    return Graph.from_edges(src, dst, nv=n)

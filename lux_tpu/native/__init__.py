@@ -1,0 +1,11 @@
+"""Native (C++) fast paths for host-side runtime work.
+
+The reference's host runtime work — partitioned parallel file loading
+(core/pull_model.inl:253-320), the edge-list converter (tools/converter.cc),
+CSR construction (sssp/sssp_gpu.cu:550-607) — is C++ there and C++ here.
+The shared library is compiled on first use with g++ and exposed through
+ctypes; every entry point has a numpy fallback so the framework works even
+without a toolchain.
+"""
+
+from lux_tpu.native import io  # noqa: F401

@@ -1,0 +1,73 @@
+"""IO entry points: converter / loader / CSR build.
+
+Each function prefers the native C++ implementation (built lazily by
+:mod:`lux_tpu.native.build`) and falls back to numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.graph import format as lux_format
+from lux_tpu.graph.graph import Graph
+
+
+def _native():
+    try:
+        from lux_tpu.native.build import load_library
+
+        return load_library()
+    except Exception:
+        return None
+
+
+def convert_edge_list(
+    input_path: str,
+    output_path: str,
+    nv: int,
+    ne: int,
+    weighted: bool = False,
+) -> None:
+    lib = _native()
+    if lib is not None:
+        rc = lib.lux_convert_edge_list(
+            input_path.encode(), output_path.encode(), nv, ne, int(weighted)
+        )
+        if rc == 0:
+            return
+    lux_format.convert_edge_list(input_path, output_path, nv, ne, weighted=weighted)
+
+
+def read_lux(path: str, weighted: Optional[bool] = None) -> Graph:
+    """Load a .lux graph; native path does a multithreaded partitioned read
+    (the TPU-host equivalent of the reference's per-part CPU load tasks,
+    core/pull_model.inl:253-320)."""
+    lib = _native()
+    if lib is not None:
+        nv, ne, has_w, _ = lux_format.detect_layout(path)
+        if weighted is None:
+            weighted = has_w
+        row_ptr = np.zeros(nv + 1, dtype=np.int64)
+        col_src = np.zeros(ne, dtype=np.int32)
+        w = np.zeros(ne, dtype=np.int32) if weighted else None
+        # Wrap raw addresses in c_void_p: bare Python ints would be
+        # truncated to 32-bit c_int by ctypes' default conversion.
+        rc = lib.lux_load(
+            path.encode(),
+            nv,
+            ne,
+            ctypes.c_void_p(row_ptr[1:].ctypes.data),
+            ctypes.c_void_p(col_src.ctypes.data),
+            ctypes.c_void_p(w.ctypes.data) if w is not None else None,
+        )
+        if rc == 0:
+            ends = row_ptr[1:]
+            if nv > 0 and (
+                not np.all(np.diff(ends) >= 0) or ends[-1] != ne
+            ):
+                raise ValueError(f"{path}: non-monotone row_ptrs")
+            return Graph(nv=nv, ne=ne, row_ptr=row_ptr, col_src=col_src, weights=w)
+    return lux_format.read_lux(path, weighted=weighted)

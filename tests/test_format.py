@@ -1,0 +1,92 @@
+"""Graph format + data model tests (golden tests vs. hand-built graphs)."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import Graph, generate, read_lux, write_lux, detect_layout
+from lux_tpu.graph.format import convert_edge_list
+
+
+def tiny_graph():
+    # 0→1, 0→2, 1→2, 2→0, 3→2 ; nv=4 (vertex 3 has no in-edges)
+    src = [0, 0, 1, 2, 3]
+    dst = [1, 2, 2, 0, 2]
+    return Graph.from_edges(np.array(src), np.array(dst), nv=4)
+
+
+def test_from_edges_csc():
+    g = tiny_graph()
+    assert g.nv == 4 and g.ne == 5
+    # Edges sorted by dst: dst order = [0, 1, 2, 2, 2]
+    np.testing.assert_array_equal(g.row_ptr, [0, 1, 2, 5, 5])
+    np.testing.assert_array_equal(g.col_src, [2, 0, 0, 1, 3])
+    np.testing.assert_array_equal(g.in_degrees, [1, 1, 3, 0])
+    np.testing.assert_array_equal(g.out_degrees, [2, 1, 1, 1])
+    np.testing.assert_array_equal(g.col_dst, [0, 1, 2, 2, 2])
+
+
+def test_csr_roundtrip():
+    g = tiny_graph()
+    csr = g.csr()
+    np.testing.assert_array_equal(csr.row_ptr, [0, 2, 3, 4, 5])
+    # out-edges grouped by src: 0→{1,2}, 1→{2}, 2→{0}, 3→{2}
+    np.testing.assert_array_equal(csr.col_dst, [1, 2, 2, 0, 2])
+
+
+def test_lux_roundtrip(tmp_path):
+    g = generate.gnp(100, 700, seed=3)
+    p = str(tmp_path / "g.lux")
+    write_lux(p, g)
+    nv, ne, has_w, has_d = detect_layout(p)
+    assert (nv, ne, has_w, has_d) == (100, 700, False, True)
+    g2 = read_lux(p)
+    np.testing.assert_array_equal(g.row_ptr, g2.row_ptr)
+    np.testing.assert_array_equal(g.col_src, g2.col_src)
+    assert g2.weights is None
+
+
+def test_lux_roundtrip_weighted(tmp_path):
+    g = generate.gnp(50, 300, seed=4, weighted=True)
+    p = str(tmp_path / "w.lux")
+    write_lux(p, g, include_degrees=False)
+    nv, ne, has_w, has_d = detect_layout(p)
+    assert (nv, ne, has_w, has_d) == (50, 300, True, False)
+    g2 = read_lux(p)
+    np.testing.assert_array_equal(g.weights, g2.weights)
+
+
+def test_binary_layout_is_reference_compatible(tmp_path):
+    """Byte-level check of the layout in tools/converter.cc:108-124."""
+    g = tiny_graph()
+    p = str(tmp_path / "t.lux")
+    write_lux(p, g)
+    raw = open(p, "rb").read()
+    assert len(raw) == 12 + 8 * 4 + 4 * 5 + 4 * 4
+    assert np.frombuffer(raw[:4], "<u4")[0] == 4
+    assert np.frombuffer(raw[4:12], "<u8")[0] == 5
+    ends = np.frombuffer(raw[12:44], "<u8")
+    np.testing.assert_array_equal(ends, [1, 2, 5, 5])
+    cols = np.frombuffer(raw[44:64], "<u4")
+    np.testing.assert_array_equal(cols, [2, 0, 0, 1, 3])
+    degs = np.frombuffer(raw[64:80], "<u4")
+    np.testing.assert_array_equal(degs, [2, 1, 1, 1])
+
+
+def test_converter_cli(tmp_path):
+    el = tmp_path / "edges.txt"
+    el.write_text("0 1\n0 2\n1 2\n2 0\n3 2\n")
+    out = str(tmp_path / "c.lux")
+    convert_edge_list(str(el), out, nv=4, ne=5)
+    g = read_lux(out)
+    np.testing.assert_array_equal(g.col_src, [2, 0, 0, 1, 3])
+
+
+def test_monotone_rowptr_rejected(tmp_path):
+    g = tiny_graph()
+    p = str(tmp_path / "bad.lux")
+    write_lux(p, g, include_degrees=False)
+    raw = bytearray(open(p, "rb").read())
+    raw[12:20] = np.asarray([5], "<u8").tobytes()  # row end 5 then 2: non-monotone
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        read_lux(p)

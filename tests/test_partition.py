@@ -1,0 +1,82 @@
+"""Partitioner tests: must reproduce the reference greedy sweep
+(core/pull_model.inl:108-131) bound-for-bound."""
+
+import numpy as np
+
+from lux_tpu.graph import Graph, generate
+from lux_tpu.graph.partition import PartitionInfo, edge_balanced_bounds
+
+
+def reference_sweep(row_ptr, num_parts):
+    """The reference greedy sweep's semantics (close part at v when the
+    running in-degree sum exceeds cap), with lux_tpu's two documented
+    divergences: overflow merges into the last part, and trailing
+    zero-in-degree vertices are folded into the last non-empty part."""
+    nv = len(row_ptr) - 1
+    ne = int(row_ptr[-1])
+    cap = (ne + num_parts - 1) // num_parts
+    bounds, left, cnt = [], 0, 0
+    for v in range(nv):
+        cnt += row_ptr[v + 1] - row_ptr[v]
+        if cnt > cap and len(bounds) < num_parts - 1:
+            bounds.append((left, v))
+            cnt = 0
+            left = v + 1
+    if left <= nv - 1:
+        bounds.append((left, nv - 1))
+        left = nv
+    while len(bounds) < num_parts:
+        bounds.append((left, left - 1))
+    return bounds
+
+
+def test_matches_reference_sweep_random():
+    for seed in range(5):
+        g = generate.gnp(200, 2000, seed=seed)
+        for parts in (1, 2, 3, 4, 8):
+            got = edge_balanced_bounds(g.row_ptr, parts)
+            want = reference_sweep(g.row_ptr, parts)
+            assert got == want, (seed, parts)
+
+
+def test_matches_reference_sweep_skewed():
+    # Star: all edges land on a few hubs.
+    g = generate.undirected(generate.star_graph(64))
+    for parts in (2, 4, 8):
+        assert edge_balanced_bounds(g.row_ptr, parts) == reference_sweep(
+            g.row_ptr, parts
+        )
+
+
+def test_bounds_cover_and_balance():
+    g = generate.rmat(12, 8, seed=1)
+    parts = 8
+    info = PartitionInfo.build(g.row_ptr, parts)
+    covered = []
+    total_edges = 0
+    for (l, r), (es, ee) in zip(info.bounds, info.edge_bounds):
+        if r >= l:
+            covered.extend(range(l, r + 1))
+            total_edges += ee - es
+    assert covered == list(range(g.nv))
+    assert total_edges == g.ne
+    # Every non-final part's edges fit under cap + max-degree slack.
+    cap = (g.ne + parts - 1) // parts
+    maxdeg = int(g.in_degrees.max())
+    for (es, ee) in info.edge_bounds[:-1]:
+        assert ee - es <= cap + maxdeg
+
+
+def test_frontier_slots_math():
+    g = generate.gnp(1000, 8000, seed=2)
+    info = PartitionInfo.build(g.row_ptr, 4)
+    for (l, r), slots in zip(info.bounds, info.frontier_slots):
+        assert slots == max(r - l, 0) // 16 + 100
+
+
+def test_empty_padding_parts():
+    g = generate.path_graph(4)  # 3 edges, ask for 8 parts
+    bounds = edge_balanced_bounds(g.row_ptr, 8)
+    assert len(bounds) == 8
+    nvs = [max(r - l + 1, 0) for l, r in bounds]
+    assert sum(nvs) >= 4  # all vertices covered by the non-empty parts

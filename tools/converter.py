@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Edge-list → ``.lux`` converter CLI.
+
+Same interface as the reference tool (tools/converter.cc:16-70):
+
+    python tools/converter.py -nv NV -ne NE -input edges.txt -output g.lux
+
+plus ``-weighted`` for 3-column (src dst weight) inputs. Uses the native
+C++ fast path when available, falling back to numpy.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__, prefix_chars="-")
+    p.add_argument("-nv", type=int, required=True, help="number of vertices")
+    p.add_argument("-ne", type=int, required=True, help="number of edges")
+    p.add_argument("-input", required=True, help="text edge list (src dst [w])")
+    p.add_argument("-output", required=True, help="output .lux path")
+    p.add_argument("-weighted", action="store_true")
+    args = p.parse_args(argv)
+    print(
+        f"nv = {args.nv} ne = {args.ne} input = {args.input} "
+        f"output = {args.output}"
+    )
+    t0 = time.time()
+    from lux_tpu.native import io as native_io
+
+    native_io.convert_edge_list(
+        args.input, args.output, args.nv, args.ne, weighted=args.weighted
+    )
+    print(f"converted in {time.time() - t0:.2f}s")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from lux_tpu.engine.program import PullProgram, EdgeCtx, VertexCtx
+from lux_tpu.engine.pull import PullExecutor
+
+__all__ = ["PullProgram", "EdgeCtx", "VertexCtx", "PullExecutor"]

@@ -1,0 +1,71 @@
+"""Vertex-program abstraction.
+
+The reference hardcodes each application's per-edge and per-vertex logic in
+a CUDA kernel (`pr_kernel` pagerank/pagerank_gpu.cu:49-102, `cf_kernel`
+col_filter/colfilter_gpu.cu:32-104, ...). Here an application is a
+:class:`PullProgram` (or :class:`PushProgram`, see push.py): three pure
+functions the engine traces into one fused XLA computation —
+
+    contrib_e = edge_contrib(src_val_e, dst_val_e, weight_e)   # per edge
+    acc_v     = combine(contrib_e for e into v)                # segment reduce
+    new_v     = apply(old_v, acc_v, ctx)                       # per vertex
+
+Everything is vectorized over edges/vertices (no per-element Python), so
+XLA fuses gather + elementwise into the reduction and the MXU/VPU see
+large dense ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class VertexCtx:
+    """Per-vertex context available to ``apply`` (local shard slice)."""
+
+    nv: int                      # global vertex count (static)
+    out_degrees: jnp.ndarray     # (local_nv,) out-degree per vertex
+    in_degrees: jnp.ndarray      # (local_nv,)
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeCtx:
+    """Per-edge context for ``edge_contrib``; every field is (ne_local, ...)."""
+
+    src_vals: jnp.ndarray
+    dst_vals: jnp.ndarray
+    weights: Optional[jnp.ndarray]
+
+
+class PullProgram:
+    """Base class for gather-apply (pull) vertex programs.
+
+    Subclasses set ``combiner`` and override the three hooks. Unused
+    gathers (e.g. ``dst_vals`` for PageRank) are dead-code-eliminated by
+    XLA, so there is no cost to the uniform signature.
+    """
+
+    name: str = "pull"
+    combiner: str = "sum"             # 'sum' | 'min' | 'max'
+    value_dtype = jnp.float32
+    value_shape: Tuple[int, ...] = ()  # trailing per-vertex dims, e.g. (K,)
+    needs_weights: bool = False
+
+    # -- hooks -----------------------------------------------------------
+
+    def init_values(self, graph) -> np.ndarray:
+        """Host-side initial vertex values, shape (nv, *value_shape)."""
+        raise NotImplementedError
+
+    def edge_contrib(self, edge: EdgeCtx) -> jnp.ndarray:
+        """Per-edge contribution toward the destination's accumulator."""
+        raise NotImplementedError
+
+    def apply(self, old_vals: jnp.ndarray, acc: jnp.ndarray, ctx: VertexCtx):
+        """Combine accumulator with the old value into the new value."""
+        raise NotImplementedError

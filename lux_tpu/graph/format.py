@@ -74,11 +74,15 @@ def read_lux(path: str, weighted: Optional[bool] = None) -> Graph:
         raise ValueError(f"{path}: truncated file")
     row_ptr = np.zeros(nv + 1, dtype=np.int64)
     row_ptr[1:] = ends
-    if nv > 0 and (not np.all(np.diff(ends) >= 0) or ends[-1] != ne):
-        # The reference asserts monotone row ptrs on load
-        # (pull_model.inl:100-102).
-        raise ValueError(f"{path}: non-monotone row_ptrs or bad edge count")
+    validate_row_ptr(ends, ne, path)
     return Graph(nv=nv, ne=ne, row_ptr=row_ptr, col_src=col_src, weights=weights)
+
+
+def validate_row_ptr(ends: np.ndarray, ne: int, path: str) -> None:
+    """Reject non-monotone end-offsets / wrong edge totals (the reference
+    asserts the same on load, pull_model.inl:100-102)."""
+    if len(ends) > 0 and (not np.all(np.diff(ends) >= 0) or ends[-1] != ne):
+        raise ValueError(f"{path}: non-monotone row_ptrs or bad edge count")
 
 
 def write_lux(path: str, g: Graph, include_degrees: bool = True) -> None:
@@ -109,10 +113,20 @@ def convert_edge_list(
     """
     ncols = 3 if weighted else 2
     data = np.loadtxt(input_path, dtype=np.int64, max_rows=ne, ndmin=2)
-    assert data.shape[0] == ne, f"expected {ne} edges, got {data.shape[0]}"
-    assert data.shape[1] >= ncols
+    if data.shape[0] != ne:
+        raise ValueError(f"expected {ne} edges, got {data.shape[0]}")
+    if data.shape[1] < ncols:
+        raise ValueError(
+            f"expected {ncols} columns (weighted={weighted}), "
+            f"got {data.shape[1]}"
+        )
     src, dst = data[:, 0], data[:, 1]
-    assert src.max(initial=0) < nv and dst.max(initial=0) < nv
+    for name, ids in (("src", src), ("dst", dst)):
+        if len(ids) and (ids.min() < 0 or ids.max() >= nv):
+            raise ValueError(
+                f"{name} ids out of range [0, {nv}): "
+                f"[{ids.min()}, {ids.max()}]"
+            )
     w = data[:, 2].astype(np.int32) if weighted else None
     g = Graph.from_edges(src, dst, nv=nv, weights=w)
     write_lux(output_path, g, include_degrees=include_degrees)

@@ -76,8 +76,8 @@ class PartitionInfo:
     def build(row_ptr: np.ndarray, num_parts: int) -> "PartitionInfo":
         bounds = edge_balanced_bounds(row_ptr, num_parts)
         edge_bounds = [
-            (int(row_ptr[l]), int(row_ptr[r + 1])) if r >= l else
-            (int(row_ptr[l]) if l < len(row_ptr) - 1 else int(row_ptr[-1]),) * 2
+            (int(row_ptr[l]), int(row_ptr[r + 1])) if r >= l
+            else (int(row_ptr[l]),) * 2   # empty part: l <= nv is in range
             for (l, r) in bounds
         ]
         slots = [

@@ -1,0 +1,6 @@
+"""The applications (the reference's pagerank/, sssp/, components/,
+col_filter/ directories, re-expressed as vertex programs)."""
+
+from lux_tpu.models.pagerank import PageRank
+
+__all__ = ["PageRank"]

@@ -33,8 +33,14 @@ def convert_edge_list(
 ) -> None:
     lib = _native()
     if lib is not None:
+        # Explicit width wrappers: bare Python ints default to 32-bit c_int
+        # and would overflow for ne >= 2**31 (RMAT27 has ne == 2**31).
         rc = lib.lux_convert_edge_list(
-            input_path.encode(), output_path.encode(), nv, ne, int(weighted)
+            input_path.encode(),
+            output_path.encode(),
+            ctypes.c_uint32(nv),
+            ctypes.c_uint64(ne),
+            ctypes.c_int(int(weighted)),
         )
         if rc == 0:
             return
@@ -57,17 +63,13 @@ def read_lux(path: str, weighted: Optional[bool] = None) -> Graph:
         # truncated to 32-bit c_int by ctypes' default conversion.
         rc = lib.lux_load(
             path.encode(),
-            nv,
-            ne,
+            ctypes.c_uint32(nv),
+            ctypes.c_uint64(ne),
             ctypes.c_void_p(row_ptr[1:].ctypes.data),
             ctypes.c_void_p(col_src.ctypes.data),
             ctypes.c_void_p(w.ctypes.data) if w is not None else None,
         )
         if rc == 0:
-            ends = row_ptr[1:]
-            if nv > 0 and (
-                not np.all(np.diff(ends) >= 0) or ends[-1] != ne
-            ):
-                raise ValueError(f"{path}: non-monotone row_ptrs")
+            lux_format.validate_row_ptr(row_ptr[1:], ne, path)
             return Graph(nv=nv, ne=ne, row_ptr=row_ptr, col_src=col_src, weights=w)
     return lux_format.read_lux(path, weighted=weighted)

@@ -1,0 +1,7 @@
+from lux_tpu.ops.segment import (
+    COMBINER_IDENTITY,
+    segment_reduce,
+    segment_sum_by_rowptr,
+)
+
+__all__ = ["segment_reduce", "segment_sum_by_rowptr", "COMBINER_IDENTITY"]

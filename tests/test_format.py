@@ -90,3 +90,48 @@ def test_monotone_rowptr_rejected(tmp_path):
     open(p, "wb").write(bytes(raw))
     with pytest.raises(ValueError):
         read_lux(p)
+
+
+def test_rmat_streaming_build_matches_from_edges():
+    """rmat() builds CSC by two-pass counting sort; must equal the
+    materialize-then-sort construction exactly (incl. weight permutation)."""
+    from lux_tpu.graph.generate import rmat, rmat_edges
+
+    scale, ef = 8, 4
+    g = rmat(scale, ef, seed=11, weighted=True)
+    srcs, dsts = [], []
+    for s, d in rmat_edges(scale, (1 << scale) * ef, seed=11, batch=1 << 24):
+        srcs.append(s)
+        dsts.append(d)
+    import numpy as _np
+
+    w = _np.random.default_rng(12).integers(
+        1, 101, size=(1 << scale) * ef, dtype=_np.int32
+    )
+    g2 = Graph.from_edges(
+        _np.concatenate(srcs), _np.concatenate(dsts), nv=1 << scale, weights=w
+    )
+    _np.testing.assert_array_equal(g.row_ptr, g2.row_ptr)
+    _np.testing.assert_array_equal(g.col_src, g2.col_src)
+    _np.testing.assert_array_equal(g.weights, g2.weights)
+
+
+def test_rmat_streaming_batched_placement():
+    """Multiple small batches must still yield a stable global dst order."""
+    from lux_tpu.graph.generate import rmat_edges
+
+    scale, ne = 6, 512
+    srcs, dsts = [], []
+    for s, d in rmat_edges(scale, ne, seed=3, batch=100):
+        srcs.append(s)
+        dsts.append(d)
+    import numpy as _np
+
+    full = Graph.from_edges(
+        _np.concatenate(srcs), _np.concatenate(dsts), nv=1 << scale
+    )
+    from lux_tpu.graph import generate as gen
+
+    g = gen.rmat(scale, ne // (1 << scale), seed=3, batch=100)
+    _np.testing.assert_array_equal(g.row_ptr, full.row_ptr)
+    _np.testing.assert_array_equal(g.col_src, full.col_src)

@@ -1,0 +1,48 @@
+"""Result checkers (`-check`): per-edge fixpoint invariants.
+
+The reference validates push results with a GPU kernel counting edges that
+violate the app's invariant, printing ``[PASS]``/``[FAIL]`` plus the
+mistake count (sssp/sssp_gpu.cu:773-843, components/components_gpu.cu:
+767-837). Same here, as one jitted reduction over all edges.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.graph.graph import Graph
+
+
+def count_violations(graph: Graph, values: np.ndarray, program) -> int:
+    """Number of edges violating ``program.edge_invariant``."""
+
+    @jax.jit
+    def _count(vals, col_src, seg_ids, weights):
+        ok = program.edge_invariant(vals[col_src], vals[seg_ids], weights)
+        # int32 count: fine unless >2^31 of the edges violate, by which
+        # point the verdict is unambiguous anyway (x64 is off by default).
+        return (~ok).sum(dtype=jnp.int32)
+
+    w = None if graph.weights is None else jnp.asarray(graph.weights)
+    return int(
+        _count(
+            jnp.asarray(values),
+            jnp.asarray(graph.col_src.astype(np.int32)),
+            jnp.asarray(graph.col_dst),
+            w,
+        )
+    )
+
+
+def check(graph: Graph, values: np.ndarray, program, verbose: bool = True):
+    """Print the reference's check verdict; returns True on pass."""
+    mistakes = count_violations(graph, values, program)
+    if mistakes == 0:
+        if verbose:
+            print("[PASS] Check task passed!")
+        return True
+    if verbose:
+        print(f"[FAIL] Check task failed (mistakes = {mistakes})")
+    return False

@@ -28,6 +28,19 @@ def _edge_index_dtype(ne: int):
     return jnp.int32 if ne < 2**31 else jnp.int64
 
 
+def run_pipelined(step, vals, num_iters: int, flush_every: int = 8):
+    """Launch ``num_iters`` async step waves, blocking only every
+    ``flush_every`` iterations. The reference pipelines all waves and waits
+    once (pagerank.cc:106-114); we additionally bound in-flight depth the
+    way its push model bounds SLIDING_WINDOW, so the dispatch queue — and
+    on CPU meshes the collective rendezvous — can't grow unboundedly."""
+    for i in range(num_iters):
+        vals = step(vals)
+        if flush_every and (i + 1) % flush_every == 0:
+            jax.block_until_ready(vals)
+    return jax.block_until_ready(vals)
+
+
 @dataclasses.dataclass
 class _DeviceGraph:
     """CSC arrays resident on one device."""
@@ -102,14 +115,15 @@ class PullExecutor:
     def step(self, vals: jnp.ndarray) -> jnp.ndarray:
         return self._step(vals, self.dgraph)
 
-    def run(self, num_iters: int, vals: Optional[jnp.ndarray] = None):
-        """Launch ``num_iters`` async step waves; block only at the end
-        (the reference's FutureMap pipelining, pagerank.cc:106-114)."""
+    def run(
+        self,
+        num_iters: int,
+        vals: Optional[jnp.ndarray] = None,
+        flush_every: int = 8,
+    ):
         if vals is None:
             vals = self.init_values()
-        for _ in range(num_iters):
-            vals = self.step(vals)
-        return jax.block_until_ready(vals)
+        return run_pipelined(self.step, vals, num_iters, flush_every)
 
 
 jax.tree_util.register_dataclass(

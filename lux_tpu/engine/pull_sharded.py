@@ -1,0 +1,136 @@
+"""Sharded pull executor: SPMD over a device mesh via ``jax.shard_map``.
+
+The communication pattern mirrors the reference's pull iteration
+(SURVEY.md §3.1) the TPU-native way:
+
+- reference: every GPU reads the *whole* old-value region through zero-copy
+  memory and gathers only its in-neighbor values FB-side
+  (pull_model.inl:454-461, pagerank_gpu.cu:34-47). Here: an ICI
+  ``all_gather`` of the per-part value shards inside ``shard_map``, then a
+  local gather by precomputed flat indices. XLA schedules the all-gather
+  to overlap with compute where possible.
+- reference: per-part new values published back to ZC (cudaMemcpy D2H,
+  pagerank_gpu.cu:148-150). Here: nothing — each shard's new values stay
+  resident; next iteration's all-gather *is* the exchange.
+- the Legion iteration-to-iteration region dependency that acts as the
+  barrier (SURVEY.md §3.1 footnote) becomes XLA's dataflow dependency
+  between consecutive jitted steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from lux_tpu.engine.program import EdgeCtx, PullProgram, VertexCtx
+from lux_tpu.engine.pull import run_pipelined
+from lux_tpu.graph.graph import Graph
+from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
+from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
+from lux_tpu.parallel.shard import ShardedGraph
+
+
+class ShardedPullExecutor:
+    """Runs a :class:`PullProgram` over an N-device 1-D mesh."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        program: PullProgram,
+        mesh: Optional[Mesh] = None,
+        num_parts: Optional[int] = None,
+        sum_strategy: str = "rowptr",
+    ):
+        if program.needs_weights and graph.weights is None:
+            raise ValueError(f"{program.name} requires an edge-weighted graph")
+        self.mesh = mesh if mesh is not None else make_mesh(num_parts)
+        self.num_parts = self.mesh.devices.size
+        self.graph = graph
+        self.program = program
+        self.sum_strategy = sum_strategy
+        self.sg = ShardedGraph.build(graph, self.num_parts)
+
+        sh = parts_sharding(self.mesh)
+        put = lambda x: jax.device_put(jnp.asarray(x), sh)
+        sgd = {
+            "src_pidx": put(self.sg.src_pidx),
+            "dst_local": put(self.sg.dst_local),
+            "local_row_ptr": put(self.sg.local_row_ptr),
+            "out_degrees": put(self.sg.out_degrees),
+            "in_degrees": put(self.sg.in_degrees),
+            "vertex_mask": put(self.sg.vertex_mask),
+        }
+        if self.sg.weights is not None:
+            sgd["weights"] = put(self.sg.weights)
+        self._device_graph = sgd
+
+        specs = {k: P(PARTS_AXIS) for k in sgd}
+        mapped = jax.shard_map(
+            self._shard_step,
+            mesh=self.mesh,
+            in_specs=(P(PARTS_AXIS), specs),
+            out_specs=P(PARTS_AXIS),
+        )
+        self._step = jax.jit(mapped, donate_argnums=0)
+
+    # -- per-shard body (runs under shard_map; block shapes (1, ...)) ----
+
+    def _shard_step(self, vals_blk, dg):
+        prog = self.program
+        max_nv = self.sg.max_nv
+        v = vals_blk[0]                                   # (max_nv, *t)
+        gathered = jax.lax.all_gather(v, PARTS_AXIS)      # (P, max_nv, *t)
+        flat = gathered.reshape((-1,) + v.shape[1:])
+        src_vals = flat[dg["src_pidx"][0]]
+        dst_ids = jnp.minimum(dg["dst_local"][0], max_nv - 1)
+        dst_vals = v[dst_ids]
+        edge = EdgeCtx(
+            src_vals=src_vals,
+            dst_vals=dst_vals,
+            weights=dg["weights"][0] if "weights" in dg else None,
+        )
+        contrib = prog.edge_contrib(edge)
+        if prog.combiner == "sum" and self.sum_strategy == "rowptr":
+            acc = segment_sum_by_rowptr(contrib, dg["local_row_ptr"][0])
+        else:
+            # Pad edges carry dst_local == max_nv: an extra trash segment
+            # sliced off below, so no combiner-identity masking is needed.
+            acc = segment_reduce(
+                contrib,
+                dg["dst_local"][0],
+                num_segments=max_nv + 1,
+                kind=prog.combiner,
+            )[:max_nv]
+        ctx = VertexCtx(
+            nv=self.graph.nv,
+            out_degrees=dg["out_degrees"][0],
+            in_degrees=dg["in_degrees"][0],
+        )
+        new = prog.apply(v, acc, ctx)
+        vmask = dg["vertex_mask"][0].reshape(
+            (max_nv,) + (1,) * (new.ndim - 1)
+        )
+        new = jnp.where(vmask, new, v)  # freeze pad vertices
+        return new[None]
+
+    # -- driver ----------------------------------------------------------
+
+    def init_values(self):
+        padded = self.sg.to_padded(self.program.init_values(self.graph))
+        return jax.device_put(jnp.asarray(padded), parts_sharding(self.mesh))
+
+    def step(self, vals):
+        return self._step(vals, self._device_graph)
+
+    def run(self, num_iters: int, vals=None, flush_every: int = 8):
+        if vals is None:
+            vals = self.init_values()
+        return run_pipelined(self.step, vals, num_iters, flush_every)
+
+    def gather_values(self, vals) -> np.ndarray:
+        """Padded device layout → global (nv, *t) host array."""
+        return self.sg.from_padded(np.asarray(jax.device_get(vals)))

@@ -2,5 +2,13 @@
 col_filter/ directories, re-expressed as vertex programs)."""
 
 from lux_tpu.models.pagerank import PageRank
+from lux_tpu.models.sssp import SSSP
+from lux_tpu.models.components import ConnectedComponents
+from lux_tpu.models.colfilter import CollaborativeFiltering
 
-__all__ = ["PageRank"]
+__all__ = [
+    "PageRank",
+    "SSSP",
+    "ConnectedComponents",
+    "CollaborativeFiltering",
+]

@@ -1,0 +1,4 @@
+from lux_tpu.parallel.mesh import make_mesh, PARTS_AXIS
+from lux_tpu.parallel.shard import ShardedGraph
+
+__all__ = ["make_mesh", "PARTS_AXIS", "ShardedGraph"]

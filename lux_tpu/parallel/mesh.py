@@ -1,0 +1,50 @@
+"""Device-mesh construction.
+
+The reference's placement layer is `LuxMapper` (core/lux_mapper.cc): a
+Legion mapper that slices one point task per partition round-robin across
+nodes/GPUs and routes regions to framebuffer or zero-copy memory. On TPU,
+placement *is* the sharding: a 1-D `jax.sharding.Mesh` over all devices
+with the graph partition axis named ``parts``. XLA's SPMD partitioner then
+plays the mapper's role — one shard of every array per device, collectives
+over ICI (and DCN across slices) instead of ZC staging.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARTS_AXIS = "parts"
+
+
+def make_mesh(
+    num_parts: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1-D mesh of ``num_parts`` devices (default: all visible devices).
+
+    ``num_parts`` folds node and per-node device counts into one axis the
+    way the reference folds them into ``numParts = gpus × nodes``
+    (pagerank/pagerank.cc:51-53).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_parts is not None:
+        if num_parts > len(devices):
+            raise ValueError(
+                f"num_parts={num_parts} > available devices {len(devices)}"
+            )
+        devices = devices[:num_parts]
+    return Mesh(np.asarray(devices), (PARTS_AXIS,))
+
+
+def parts_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (P, ...) stacked per-part arrays: leading axis on the
+    parts axis."""
+    return NamedSharding(mesh, P(PARTS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -2,17 +2,24 @@
 
 This is the TPU-world answer to "multi-node testing without a cluster"
 (SURVEY.md §4): every sharded code path runs on 8 simulated devices.
+
+Note: this environment's sitecustomize registers an `axon` TPU backend at
+interpreter start (so JAX_PLATFORMS from the environment is overridden);
+we force the CPU platform through jax.config instead, which works as long
+as no backend has been initialized yet.
 """
 
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

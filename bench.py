@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Headline benchmark: PageRank GTEPS on an R-MAT graph, one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation: the reference repo publishes no numbers
+(BASELINE.md); its VLDB'17 paper's 8-GPU Twitter-2010 PageRank throughput
+is on the order of 10 GTEPS. BASELINE.json's north star is ">=1x the
+8xV100 GTEPS on Twitter-2010 PageRank on v5e-8"; this bench runs on ONE
+v5e chip, so we report vs_baseline against BASELINE_GTEPS / 8 (the per-GPU
+share), keeping the number honest for single-chip hardware.
+
+Knobs (env): LUX_BENCH_SCALE (default 22 → 4.19M vertices, 67.1M edges),
+LUX_BENCH_EF (16), LUX_BENCH_ITERS (20), LUX_BENCH_CACHE (.bench_cache).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_GTEPS = 10.0      # assumed 8xV100 Twitter-2010 PageRank (see above)
+PER_CHIP_BASELINE = BASELINE_GTEPS / 8.0
+
+
+def get_graph(scale: int, ef: int, cache_dir: str):
+    from lux_tpu.graph import generate, read_lux, write_lux
+
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"rmat{scale}_{ef}.lux")
+    if os.path.exists(path):
+        t0 = time.time()
+        g = read_lux(path)
+        print(f"# loaded cached {path} in {time.time()-t0:.1f}s", file=sys.stderr)
+        return g
+    t0 = time.time()
+    g = generate.rmat(scale, ef, seed=42)
+    print(f"# generated rmat{scale} in {time.time()-t0:.1f}s", file=sys.stderr)
+    write_lux(path, g)
+    return g
+
+
+def main():
+    scale = int(os.environ.get("LUX_BENCH_SCALE", "22"))
+    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
+    iters = int(os.environ.get("LUX_BENCH_ITERS", "20"))
+    cache = os.environ.get("LUX_BENCH_CACHE",
+                           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                        ".bench_cache"))
+
+    from lux_tpu.utils.platform import ensure_backend
+
+    platform = ensure_backend()
+    print(f"# platform: {platform}", file=sys.stderr)
+
+    g = get_graph(scale, ef, cache)
+    from lux_tpu.engine.pull import PullExecutor
+    from lux_tpu.models import PageRank
+
+    from lux_tpu.engine.pull import hard_sync
+
+    ex = PullExecutor(g, PageRank())
+    ex.warmup()
+
+    # Timed: `iters` iterations, async-pipelined, one hard sync at the end
+    # (the reference's measurement discipline, pagerank.cc:106-118;
+    # hard_sync because block_until_ready returns early on tunneled
+    # backends and would fake a ~1000x speedup).
+    vals = hard_sync(ex.run(2, flush_every=0))  # settle caches
+    t0 = time.perf_counter()
+    vals = ex.run(iters, vals=vals, flush_every=0)
+    elapsed = time.perf_counter() - t0
+
+    gteps = g.ne * iters / elapsed / 1e9
+    print(
+        f"# nv={g.nv} ne={g.ne} iters={iters} elapsed={elapsed:.4f}s "
+        f"({elapsed/iters*1e3:.2f} ms/iter)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"pagerank_rmat{scale}_gteps_1chip",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / PER_CHIP_BASELINE, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

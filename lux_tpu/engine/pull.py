@@ -28,6 +28,19 @@ def _edge_index_dtype(ne: int):
     return jnp.int32 if ne < 2**31 else jnp.int64
 
 
+def hard_sync(x):
+    """Wait until ``x`` is actually materialized on device.
+
+    ``jax.block_until_ready`` can return early on tunneled/async backends
+    (observed on the axon TPU relay: it returned in 1.6ms while the queued
+    work took 28s); fetching one element forces real completion through
+    the dataflow dependency."""
+    jax.block_until_ready(x)
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    jax.device_get(leaf.ravel()[:1])
+    return x
+
+
 def run_pipelined(step, vals, num_iters: int, flush_every: int = 8):
     """Launch ``num_iters`` async step waves, blocking only every
     ``flush_every`` iterations. The reference pipelines all waves and waits
@@ -38,7 +51,7 @@ def run_pipelined(step, vals, num_iters: int, flush_every: int = 8):
         vals = step(vals)
         if flush_every and (i + 1) % flush_every == 0:
             jax.block_until_ready(vals)
-    return jax.block_until_ready(vals)
+    return hard_sync(vals)
 
 
 @dataclasses.dataclass
@@ -114,6 +127,13 @@ class PullExecutor:
 
     def step(self, vals: jnp.ndarray) -> jnp.ndarray:
         return self._step(vals, self.dgraph)
+
+    def warmup(self):
+        """Run one throwaway step through the run() path outside any timed
+        region (the reference's kernels are compiled at build time, so its
+        ELAPSED TIME never includes compilation; hard_sync also primes the
+        transfer path on tunneled backends)."""
+        hard_sync(self.step(self.init_values()))
 
     def run(
         self,

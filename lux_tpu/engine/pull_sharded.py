@@ -126,6 +126,11 @@ class ShardedPullExecutor:
     def step(self, vals):
         return self._step(vals, self._device_graph)
 
+    def warmup(self):
+        from lux_tpu.engine.pull import hard_sync
+
+        hard_sync(self.step(self.init_values()))
+
     def run(self, num_iters: int, vals=None, flush_every: int = 8):
         if vals is None:
             vals = self.init_values()

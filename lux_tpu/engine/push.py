@@ -24,16 +24,20 @@ masked to the combiner identity:
 
 This is work-suboptimal for tiny frontiers (O(ne) per iteration instead of
 O(frontier edges)) but every op is a large dense VPU-friendly computation;
-a Pallas sparse path is layered on later. Because the fixpoint is monotone,
-speculative extra iterations are harmless — which is exactly what makes the
-reference's SLIDING_WINDOW=4 pipelining valid (sssp/sssp.cc:111-129), and
-we reuse the same trick: the host blocks on the active-count of iteration
-i-4 while iterations i-3..i are already enqueued.
+a Pallas sparse path is layered on later.
+
+Halt detection: the reference hides the per-iteration host round-trip for
+the active count behind a 4-deep speculative window (SLIDING_WINDOW,
+sssp/sssp.cc:111-129) — valid because the fixpoint is monotone, so extra
+iterations are harmless. The TPU-native form goes further: up to ``chunk``
+iterations run under one ``lax.while_loop`` dispatch with on-device early
+exit, and the host reads one count batch per chunk. Same monotonicity
+argument, ~chunk× fewer synchronizations (this round-trip is SURVEY.md
+§7 hard-part (c)).
 """
 
 from __future__ import annotations
 
-import collections
 from typing import NamedTuple, Optional
 
 import jax
@@ -41,13 +45,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from lux_tpu.engine.pull import hard_sync
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.segment import identity_for, segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
 from lux_tpu.parallel.shard import ShardedGraph
-
-SLIDING_WINDOW = 4  # speculative in-flight iterations (sssp/app.h:20)
-
 
 class PushProgram:
     """Frontier-driven vertex program (SSSP, CC, ...)."""
@@ -79,6 +81,36 @@ class PushState(NamedTuple):
     frontier: jnp.ndarray   # bool, same shape
 
 
+def _chunk_while(one_iter, state: PushState, k: int, limit):
+    """Run up to ``min(k, limit)`` fixpoint iterations on-device with
+    early exit.
+
+    The reference pays one host round-trip per iteration past its 4-deep
+    window to read the halt count (sssp.cc:116-124); on TPU (especially a
+    tunneled one) that round-trip dominates tiny iterations, so the whole
+    loop runs under ``lax.while_loop`` and the host syncs once per chunk.
+    ``k`` is static (compiled once); ``limit`` is a traced bound so partial
+    final chunks reuse the same executable instead of recompiling.
+    Returns (state, counts[k], iters_done, last_count).
+    """
+
+    def cond(carry):
+        _, i, last, _ = carry
+        return (i < jnp.minimum(k, limit)) & (last > 0)
+
+    def body(carry):
+        st, i, _, counts = carry
+        st, cnt = one_iter(st)
+        counts = jax.lax.dynamic_update_index_in_dim(
+            counts, cnt, i, axis=0
+        )
+        return st, i + 1, cnt, counts
+
+    init = (state, jnp.int32(0), jnp.int32(1), jnp.zeros(k, jnp.int32))
+    st, done, last, counts = jax.lax.while_loop(cond, body, init)
+    return st, counts, done, last
+
+
 class PushExecutor:
     """Single-device push executor."""
 
@@ -95,6 +127,9 @@ class PushExecutor:
             None if graph.weights is None else put(graph.weights)
         )
         self._step = jax.jit(self._step_impl, donate_argnums=0)
+        self._multi_jit = jax.jit(
+            self._chunk_impl, donate_argnums=0, static_argnums=5
+        )
 
     def _step_impl(self, state: PushState, col_src, seg_ids, weights):
         prog = self.program
@@ -111,6 +146,12 @@ class PushExecutor:
             new = jnp.maximum(state.values, acc)
         frontier = new != state.values
         return PushState(new, frontier), frontier.sum(dtype=jnp.int32)
+
+    def _chunk_impl(
+        self, state: PushState, col_src, seg_ids, weights, limit, k: int
+    ):
+        one_iter = lambda st: self._step_impl(st, col_src, seg_ids, weights)
+        return _chunk_while(one_iter, state, k, limit)
 
     def init_state(self, **kw) -> PushState:
         vals = jax.device_put(
@@ -131,26 +172,58 @@ class PushExecutor:
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
         verbose: bool = False,
+        chunk: int = 16,
         **init_kw,
     ):
-        """Iterate to fixpoint with SLIDING_WINDOW-deep speculative
-        pipelining; returns (final_state, iterations_run)."""
+        """Iterate to fixpoint; returns (final_state, iterations_run).
+
+        Runs ``chunk`` iterations per device dispatch with on-device early
+        exit; the host reads back one count batch per chunk."""
         if state is None:
             state = self.init_state(**init_kw)
-        window = collections.deque()
-        it = 0
-        while max_iters is None or it < max_iters:
-            state, cnt = self.step(state)
-            window.append(cnt)
-            it += 1
-            if len(window) >= SLIDING_WINDOW:
-                done = int(window.popleft())  # blocks on iteration it-4
-                if verbose:
-                    print(f"iter {it - SLIDING_WINDOW}: active {done}")
-                if done == 0:
-                    break
-        jax.block_until_ready(state.values)
-        return state, it
+        return _run_to_fixpoint(self._multi, state, max_iters, chunk, verbose)
+
+    def _multi(self, state: PushState, limit: int, k: int):
+        return self._multi_jit(
+            state,
+            self._col_src,
+            self._seg_ids,
+            self._weights,
+            jnp.int32(limit),
+            k,
+        )
+
+    def warmup(self, chunk: int = 16, **init_kw):
+        """Run one throwaway iteration through the exact run() path so
+        ELAPSED TIME excludes XLA compilation AND first-transfer setup
+        (both disproportionately slow on tunneled backends)."""
+        _run_to_fixpoint(
+            self._multi, self.init_state(**init_kw), 1, chunk, False
+        )
+
+
+def _run_to_fixpoint(multi, state, max_iters, chunk, verbose):
+    total = 0
+    while True:
+        limit = chunk if max_iters is None else min(chunk, max_iters - total)
+        if limit <= 0:
+            break
+        k = chunk
+        state, counts, done, last = multi(state, limit, k)
+        # One batched transfer: on a tunneled TPU every device_get is a
+        # full round-trip (~tens of ms), so fetch all three together.
+        counts_h, done_h, last_h = jax.device_get((counts, done, last))
+        done_i = int(np.asarray(done_h).reshape(-1)[0])
+        last_i = int(np.asarray(last_h).reshape(-1)[0])
+        if verbose:
+            ch = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
+            for j, c in enumerate(ch):
+                print(f"iter {total + j}: active {int(c)}")
+        total += done_i
+        if last_i == 0 or done_i == 0:
+            break
+    hard_sync(state.values)
+    return state, total
 
 
 class ShardedPushExecutor:
@@ -182,16 +255,20 @@ class ShardedPushExecutor:
         }
         if self.sg.weights is not None:
             self._dg["weights"] = put(self.sg.weights)
-        specs = {k: P(PARTS_AXIS) for k in self._dg}
+        self._specs = {k: P(PARTS_AXIS) for k in self._dg}
+        state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
         mapped = jax.shard_map(
             self._shard_step,
             mesh=self.mesh,
-            in_specs=(PushState(P(PARTS_AXIS), P(PARTS_AXIS)), specs),
-            out_specs=(PushState(P(PARTS_AXIS), P(PARTS_AXIS)), P(PARTS_AXIS)),
+            in_specs=(state_spec, self._specs),
+            out_specs=(state_spec, P(PARTS_AXIS)),
         )
         self._step = jax.jit(mapped, donate_argnums=0)
+        self._chunk_cache = {}
 
-    def _shard_step(self, state: PushState, dg):
+    def _iter_block(self, state: PushState, dg):
+        """One iteration on this shard's (1, ...) blocks; returns the new
+        blocks and the *local* new-frontier count."""
         prog = self.program
         max_nv = self.sg.max_nv
         v = state.values[0]
@@ -217,7 +294,38 @@ class ShardedPushExecutor:
         new = jnp.where(vmask, new, v)
         frontier = (new != v) & vmask
         cnt = frontier.sum(dtype=jnp.int32)
-        return PushState(new[None], frontier[None]), cnt[None]
+        return PushState(new[None], frontier[None]), cnt
+
+    def _shard_step(self, state: PushState, dg):
+        new_state, cnt = self._iter_block(state, dg)
+        return new_state, cnt[None]
+
+    def _shard_chunk(self, state: PushState, dg, limit, k: int):
+        def one_iter(st):
+            new_state, cnt_local = self._iter_block(st, dg)
+            return new_state, jax.lax.psum(cnt_local, PARTS_AXIS)
+
+        st, counts, done, last = _chunk_while(one_iter, state, k, limit[0])
+        return st, counts[None], done[None], last[None]
+
+    def _multi(self, state: PushState, limit: int, k: int):
+        if k not in self._chunk_cache:
+            state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
+            mapped = jax.shard_map(
+                lambda st, dg, lim: self._shard_chunk(st, dg, lim, k),
+                mesh=self.mesh,
+                in_specs=(state_spec, self._specs, P()),
+                out_specs=(
+                    state_spec,
+                    P(PARTS_AXIS),
+                    P(PARTS_AXIS),
+                    P(PARTS_AXIS),
+                ),
+            )
+            self._chunk_cache[k] = jax.jit(mapped, donate_argnums=0)
+        return self._chunk_cache[k](
+            state, self._dg, jnp.full((1,), limit, jnp.int32)
+        )
 
     def init_state(self, **kw) -> PushState:
         sh = parts_sharding(self.mesh)
@@ -243,24 +351,17 @@ class ShardedPushExecutor:
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
         verbose: bool = False,
+        chunk: int = 16,
         **init_kw,
     ):
         if state is None:
             state = self.init_state(**init_kw)
-        window = collections.deque()
-        it = 0
-        while max_iters is None or it < max_iters:
-            state, cnts = self.step(state)
-            window.append(cnts)
-            it += 1
-            if len(window) >= SLIDING_WINDOW:
-                done = int(np.asarray(window.popleft()).sum())
-                if verbose:
-                    print(f"iter {it - SLIDING_WINDOW}: active {done}")
-                if done == 0:
-                    break
-        jax.block_until_ready(state.values)
-        return state, it
+        return _run_to_fixpoint(self._multi, state, max_iters, chunk, verbose)
+
+    def warmup(self, chunk: int = 16, **init_kw):
+        _run_to_fixpoint(
+            self._multi, self.init_state(**init_kw), 1, chunk, False
+        )
 
     def gather_values(self, state: PushState) -> np.ndarray:
         return self.sg.from_padded(np.asarray(jax.device_get(state.values)))

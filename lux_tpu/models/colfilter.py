@@ -72,3 +72,20 @@ def rmse(graph: Graph, vec: np.ndarray) -> float:
     dv = vec[graph.col_dst].astype(np.float64)
     err = graph.weights.astype(np.float64) - np.sum(sv * dv, axis=-1)
     return float(np.sqrt(np.mean(err**2)))
+
+
+def main(argv=None):
+    """CLI: python -m lux_tpu.models.colfilter -file g.lux -ni 10"""
+    from lux_tpu.models.cli import run_pull_app
+
+    return run_pull_app(
+        CollaborativeFiltering(),
+        argv,
+        oracle=lambda g, ni: reference_colfilter(g, ni),
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

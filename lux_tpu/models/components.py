@@ -59,3 +59,16 @@ def reference_components(graph: Graph) -> np.ndarray:
     label = np.zeros(graph.nv, dtype=np.uint32)
     np.maximum.at(label, roots, np.arange(graph.nv, dtype=np.uint32))
     return label[roots]
+
+
+def main(argv=None):
+    """CLI: python -m lux_tpu.models.components -file g.lux [-check]"""
+    from lux_tpu.models.cli import run_push_app
+
+    return run_push_app(ConnectedComponents(), argv, supports_start=False)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -56,3 +56,16 @@ def reference_sssp(graph: Graph, start: int = 0) -> np.ndarray:
                     nxt.append(int(v))
         frontier = nxt
     return dist
+
+
+def main(argv=None):
+    """CLI: python -m lux_tpu.models.sssp -file g.lux -start R [-check]"""
+    from lux_tpu.models.cli import run_push_app
+
+    return run_push_app(SSSP(), argv, supports_start=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,4 @@
+from lux_tpu.utils.logging import get_logger
+from lux_tpu.utils.timing import Timer
+
+__all__ = ["get_logger", "Timer"]

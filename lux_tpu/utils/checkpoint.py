@@ -1,0 +1,49 @@
+"""Checkpoint / resume of vertex state.
+
+The reference has none (SURVEY.md §5: state lives in device regions and is
+never written back). Here vertex values are plain arrays, so checkpointing
+is one compressed npz per snapshot: values + iteration counter + graph
+fingerprint (to refuse resuming onto a different graph).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from lux_tpu.graph.graph import Graph
+
+
+def _fingerprint(graph: Graph) -> np.ndarray:
+    # Cheap structural hash: counts plus a sample of the edge array.
+    sample = graph.col_src[:: max(1, graph.ne // 1024)][:1024]
+    return np.array(
+        [graph.nv, graph.ne, int(sample.astype(np.int64).sum())],
+        dtype=np.int64,
+    )
+
+
+def save(path: str, graph: Graph, values: np.ndarray, iteration: int,
+         frontier: Optional[np.ndarray] = None) -> None:
+    payload = {
+        "values": values,
+        "iteration": np.int64(iteration),
+        "fingerprint": _fingerprint(graph),
+    }
+    if frontier is not None:
+        payload["frontier"] = frontier
+    np.savez_compressed(path, **payload)
+
+
+def load(
+    path: str, graph: Graph
+) -> Tuple[np.ndarray, int, Optional[np.ndarray]]:
+    with np.load(path) as z:
+        if not np.array_equal(z["fingerprint"], _fingerprint(graph)):
+            raise ValueError(
+                f"{path}: checkpoint belongs to a different graph"
+            )
+        frontier = z["frontier"] if "frontier" in z.files else None
+        return z["values"], int(z["iteration"]), frontier

@@ -1,0 +1,37 @@
+"""Category loggers.
+
+The reference uses Legion logger categories — ``log_lux("graph")``,
+``log_pr("pagerank")`` etc. (core/pull_model.inl:20, pagerank/pagerank.cc:26)
+— with a compile-time OUTPUT_LEVEL knob (Makefile:23). Here: stdlib logging
+with a ``LUX_LOG`` env var as the runtime knob.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure():
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("LUX_LOG", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("{%(name)s} %(levelname)s: %(message)s")
+    )
+    root = logging.getLogger("lux")
+    root.setLevel(getattr(logging, level, logging.INFO))
+    root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(category: str) -> logging.Logger:
+    """e.g. get_logger('graph'), get_logger('pagerank')."""
+    _configure()
+    return logging.getLogger(f"lux.{category}")

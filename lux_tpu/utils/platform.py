@@ -1,0 +1,20 @@
+"""Backend selection hygiene.
+
+In some environments a TPU plugin platform is forced via JAX_PLATFORMS but
+its registration can fail (plugin import error, device held elsewhere).
+``ensure_backend()`` makes CLIs degrade to CPU instead of crashing.
+"""
+
+from __future__ import annotations
+
+
+def ensure_backend() -> str:
+    """Return the platform actually in use, falling back to CPU if the
+    configured platform cannot initialize."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform

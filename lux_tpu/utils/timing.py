@@ -1,0 +1,27 @@
+"""Wall-clock timing.
+
+The reference brackets its iteration loop with
+``Realm::Clock::current_time_in_microseconds`` and prints
+``ELAPSED TIME = %7.7f s`` (pagerank/pagerank.cc:108-118); `Timer`
+reproduces that measurement discipline (device work must be drained before
+reading the clock — the executors' ``run`` methods block before
+returning, so bracketing them is accurate).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __enter__(self):
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.start
+        return False
+
+    def print_elapsed(self):
+        # Same format string family as the reference (pagerank.cc:117).
+        print(f"ELAPSED TIME = {self.elapsed:7.7f} s")

@@ -104,8 +104,8 @@ def test_sharded_cc_parity(parts):
     np.testing.assert_array_equal(got, reference_components(g))
 
 
-def test_sliding_window_halt_runs_extra_safe_iters():
-    # Fixpoint must be unchanged by the <=4 speculative iterations.
+def test_chunked_halt_runs_exact_fixpoint():
+    # Fixpoint must be unchanged by chunked on-device early-exit iteration.
     g = generate.path_graph(20)
     ex = PushExecutor(g, SSSP())
     state, iters = ex.run(start=0)

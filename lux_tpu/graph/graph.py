@@ -84,17 +84,59 @@ class Graph:
         """Out-edge (push) view: edges grouped by source.
 
         The reference builds this per GPU at init time via a degree
-        histogram + prefix sum + scatter (sssp/sssp_gpu.cu:550-607); here
-        it is a stable argsort of the CSC edge list by source.
+        histogram + prefix sum + scatter (sssp/sssp_gpu.cu:550-607); the
+        native C++ path does the same (lux_native.cc lux_build_csr);
+        the numpy fallback is a stable argsort by source.
         """
         if self._csr is None:
-            order = np.argsort(self.col_src, kind="stable").astype(np.int64)
-            dst = self.col_dst[order].astype(np.int32)
-            ptr = np.zeros(self.nv + 1, dtype=np.int64)
-            np.cumsum(self.out_degrees, out=ptr[1:])
-            w = None if self.weights is None else self.weights[order]
-            self._csr = Csr(row_ptr=ptr, col_dst=dst, weights=w)
+            self._csr = self._csr_native() or self._csr_numpy()
         return self._csr
+
+    def _csr_native(self):
+        import ctypes
+
+        from lux_tpu.native.build import maybe_library
+
+        lib = maybe_library()
+        if lib is None:
+            return None
+        ptr = np.zeros(self.nv + 1, dtype=np.int64)
+        dst = np.zeros(self.ne, dtype=np.int32)
+        w = None if self.weights is None else np.zeros(self.ne, np.int32)
+        col_src = np.ascontiguousarray(self.col_src, dtype=np.int32)
+        # Keep every buffer alive in a local: c_void_p captures only the
+        # raw address, so an inline temporary would be freed pre-call.
+        csc_ptr = np.ascontiguousarray(self.row_ptr, np.int64)
+        weights = (
+            None
+            if self.weights is None
+            else np.ascontiguousarray(self.weights, dtype=np.int32)
+        )
+        rc = lib.lux_build_csr(
+            ctypes.c_uint32(self.nv),
+            ctypes.c_uint64(self.ne),
+            ctypes.c_void_p(col_src.ctypes.data),
+            ctypes.c_void_p(csc_ptr.ctypes.data),
+            ctypes.c_void_p(ptr.ctypes.data),
+            ctypes.c_void_p(dst.ctypes.data),
+            ctypes.c_void_p(weights.ctypes.data) if weights is not None else None,
+            ctypes.c_void_p(w.ctypes.data) if w is not None else None,
+        )
+        if rc != 0:
+            if rc == -6:
+                raise ValueError(
+                    f"col_src contains ids outside [0, {self.nv})"
+                )
+            return None
+        return Csr(row_ptr=ptr, col_dst=dst, weights=w)
+
+    def _csr_numpy(self) -> "Csr":
+        order = np.argsort(self.col_src, kind="stable").astype(np.int64)
+        dst = self.col_dst[order].astype(np.int32)
+        ptr = np.zeros(self.nv + 1, dtype=np.int64)
+        np.cumsum(self.out_degrees, out=ptr[1:])
+        w = None if self.weights is None else self.weights[order]
+        return Csr(row_ptr=ptr, col_dst=dst, weights=w)
 
     # -- constructors ----------------------------------------------------
 

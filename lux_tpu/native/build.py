@@ -1,0 +1,102 @@
+"""Lazy build + ctypes binding for lux_native.cc.
+
+Compiled once into a cache directory keyed by a source hash; rebuilt
+automatically when the source changes. All argtypes are configured here —
+ctypes' default c_int conversion would truncate 64-bit pointers/sizes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lux_native.cc")
+_LOCK = threading.Lock()
+_LIB = None
+_FAILED = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get(
+        "LUX_NATIVE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "lux_tpu_native"
+        ),
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.lux_load.restype = ctypes.c_int
+    lib.lux_load.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.lux_convert_edge_list.restype = ctypes.c_int
+    lib.lux_convert_edge_list.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.lux_build_csr.restype = ctypes.c_int
+    lib.lux_build_csr.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    return lib
+
+
+def maybe_library():
+    """load_library() or None — the shared soft-failure wrapper every
+    native call site uses before falling back to numpy."""
+    try:
+        return load_library()
+    except Exception:
+        return None
+
+
+def load_library() -> ctypes.CDLL:
+    """Compile (if needed) and load the native library. Raises on any
+    failure — callers fall back to numpy."""
+    global _LIB, _FAILED
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _FAILED:
+            raise RuntimeError("native build previously failed")
+        try:
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            so_path = os.path.join(_cache_dir(), f"lux_native_{digest}.so")
+            if not os.path.exists(so_path):
+                tmp = so_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-shared", "-fPIC", "-pthread",
+                        "-std=c++17", "-o", tmp, _SRC,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, so_path)
+            _LIB = _configure(ctypes.CDLL(so_path))
+            return _LIB
+        except Exception:
+            _FAILED = True
+            raise

@@ -1,0 +1,99 @@
+"""Native C++ IO paths vs numpy fallbacks (skipped if no toolchain)."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import Graph, generate, write_lux
+from lux_tpu.graph import format as lux_format
+
+
+def native_lib():
+    try:
+        from lux_tpu.native.build import load_library
+
+        return load_library()
+    except Exception:
+        return None
+
+
+pytestmark = pytest.mark.skipif(
+    native_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def test_native_load_matches_python(tmp_path):
+    from lux_tpu.native import io as nio
+
+    g = generate.rmat(10, 8, seed=3, weighted=True)
+    p = str(tmp_path / "g.lux")
+    write_lux(p, g)
+    g2 = nio.read_lux(p)
+    np.testing.assert_array_equal(g.row_ptr, g2.row_ptr)
+    np.testing.assert_array_equal(g.col_src, g2.col_src)
+    np.testing.assert_array_equal(g.weights, g2.weights)
+    g3 = lux_format.read_lux(p)
+    np.testing.assert_array_equal(g2.col_src, g3.col_src)
+
+
+def test_native_convert_matches_python(tmp_path):
+    from lux_tpu.native import io as nio
+
+    rng = np.random.default_rng(5)
+    ne, nv = 500, 64
+    src = rng.integers(0, nv, ne)
+    dst = rng.integers(0, nv, ne)
+    w = rng.integers(-3, 100, ne)
+    el = tmp_path / "edges.txt"
+    el.write_text(
+        "".join(f"{s} {d} {x}\n" for s, d, x in zip(src, dst, w))
+    )
+    out_native = str(tmp_path / "n.lux")
+    nio.convert_edge_list(str(el), out_native, nv, ne, weighted=True)
+    want = Graph.from_edges(src, dst, nv=nv, weights=w.astype(np.int32))
+    got = lux_format.read_lux(out_native)
+    np.testing.assert_array_equal(got.row_ptr, want.row_ptr)
+    np.testing.assert_array_equal(got.col_src, want.col_src)
+    np.testing.assert_array_equal(got.weights, want.weights)  # stability
+    np.testing.assert_array_equal(got.out_degrees, want.out_degrees)
+
+
+def test_native_convert_rejects_bad_ids(tmp_path):
+    lib = native_lib()
+    el = tmp_path / "bad.txt"
+    el.write_text("0 1\n5 2\n")  # 5 >= nv
+    rc = lib.lux_convert_edge_list(
+        str(el).encode(), str(tmp_path / "x.lux").encode(), 4, 2, 0
+    )
+    assert rc == -2
+
+
+def test_native_csr_matches_numpy():
+    g = generate.rmat(9, 8, seed=7, weighted=True)
+    native = g._csr_native()
+    assert native is not None
+    ref = g._csr_numpy()
+    np.testing.assert_array_equal(native.row_ptr, ref.row_ptr)
+    np.testing.assert_array_equal(native.col_dst, ref.col_dst)
+    np.testing.assert_array_equal(native.weights, ref.weights)
+
+
+def test_native_load_detects_size_mismatch(tmp_path):
+    lib = native_lib()
+    p = tmp_path / "trunc.lux"
+    g = generate.gnp(50, 200, seed=1)
+    write_lux(str(p), g, include_degrees=False)
+    data = p.read_bytes()[:-100]
+    p.write_bytes(data)
+    row_ends = np.zeros(50, np.int64)
+    cols = np.zeros(200, np.int32)
+    import ctypes
+
+    rc = lib.lux_load(
+        str(p).encode(),
+        ctypes.c_uint32(50),
+        ctypes.c_uint64(200),
+        ctypes.c_void_p(row_ends.ctypes.data),
+        ctypes.c_void_p(cols.ctypes.data),
+        None,
+    )
+    assert rc == -3

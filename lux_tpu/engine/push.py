@@ -112,33 +112,78 @@ def _chunk_while(one_iter, state: PushState, k: int, limit):
 
 
 class PushExecutor:
-    """Single-device push executor."""
+    """Single-device push executor with adaptive direction switching.
 
-    def __init__(self, graph: Graph, program: PushProgram, device=None):
+    Two per-iteration strategies, chosen on-device by ``lax.cond`` the way
+    the reference switches per iteration (sssp_gpu.cu:414-421):
+
+    - **dense (pull direction)**: masked relax over all CSC in-edges —
+      O(ne) but fully vectorized. Used for large frontiers.
+    - **sparse (push direction)**: compact the frontier into a bounded
+      queue (the FrontierHeader/queue design, push_model.inl:390-412,
+      made static-shape), expand exactly the queued vertices' out-edges
+      through the CSR, scatter-combine the candidates. Work scales with
+      the *edge budget*, not ne — the win when frontiers are small, since
+      on TPU gathers/scatters cost per element.
+
+    Sparse is taken when the previous frontier fits the queue AND its
+    out-edge total fits the edge budget; otherwise dense (the reference's
+    sparse→dense overflow fallback, sssp_gpu.cu:462-491).
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        program: PushProgram,
+        device=None,
+        sparse: bool = True,
+        queue_frac: int = 16,     # queue capacity = nv/queue_frac + slack
+        edge_budget_frac: int = 8,  # edge budget = ne/edge_budget_frac
+    ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
         self.graph = graph
         self.program = program
         self.device = device
         put = lambda x: jax.device_put(jnp.asarray(x), device)
-        self._col_src = put(graph.col_src.astype(np.int32))
-        self._seg_ids = put(graph.col_dst)
-        self._weights = (
-            None if graph.weights is None else put(graph.weights)
-        )
+        dg = {
+            "col_src": put(graph.col_src.astype(np.int32)),
+            "seg_ids": put(graph.col_dst),
+        }
+        if graph.weights is not None:
+            dg["weights"] = put(graph.weights)
+        self.sparse = sparse and graph.ne >= 1024
+        if self.sparse:
+            # Queue capacity mirrors the reference's per-part sparse queue
+            # sizing (nv/SPARSE_THRESHOLD + slack, push_model.inl:390-412).
+            self.queue_cap = int(graph.nv) // queue_frac + 128
+            self.edge_budget = max(int(graph.ne) // edge_budget_frac, 1024)
+            from lux_tpu.engine.pull import _edge_index_dtype
+
+            csr = graph.csr()
+            eidx = _edge_index_dtype(graph.ne)
+            dg["csr_row_ptr"] = put(csr.row_ptr.astype(eidx))
+            dg["csr_col_dst"] = put(csr.col_dst)
+            if csr.weights is not None:
+                dg["csr_weights"] = put(csr.weights)
+            dg["out_degrees"] = put(graph.out_degrees.astype(np.int32))
+        self._dg = dg
         self._step = jax.jit(self._step_impl, donate_argnums=0)
         self._multi_jit = jax.jit(
-            self._chunk_impl, donate_argnums=0, static_argnums=5
+            self._chunk_impl, donate_argnums=0, static_argnums=2
         )
 
-    def _step_impl(self, state: PushState, col_src, seg_ids, weights):
+    # -- dense (pull-direction) iteration --------------------------------
+
+    def _dense_iter(self, state: PushState, dg):
         prog = self.program
-        src_vals = state.values[col_src]
-        cand = prog.relax(src_vals, weights)
+        src_vals = state.values[dg["col_src"]]
+        cand = prog.relax(src_vals, dg.get("weights"))
         ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(state.frontier[col_src], cand, ident)
+        cand = jnp.where(state.frontier[dg["col_src"]], cand, ident)
         acc = segment_reduce(
-            cand, seg_ids, num_segments=self.graph.nv, kind=prog.combiner
+            cand, dg["seg_ids"], num_segments=self.graph.nv,
+            kind=prog.combiner,
         )
         if prog.combiner == "min":
             new = jnp.minimum(state.values, acc)
@@ -147,10 +192,74 @@ class PushExecutor:
         frontier = new != state.values
         return PushState(new, frontier), frontier.sum(dtype=jnp.int32)
 
-    def _chunk_impl(
-        self, state: PushState, col_src, seg_ids, weights, limit, k: int
-    ):
-        one_iter = lambda st: self._step_impl(st, col_src, seg_ids, weights)
+    # -- sparse (push-direction) iteration -------------------------------
+
+    def _sparse_iter(self, state: PushState, dg):
+        prog = self.program
+        nv, Q, E = self.graph.nv, self.queue_cap, self.edge_budget
+        values, frontier = state
+        # 1. Frontier → bounded queue (ids sorted ascending; pad slot nv).
+        q = jnp.nonzero(frontier, size=Q, fill_value=nv)[0].astype(jnp.int32)
+        # Padded row_ptr lookup: q == nv yields start == end == ne.
+        rp = dg["csr_row_ptr"]
+        start = rp[q]
+        deg = rp[jnp.minimum(q + 1, nv)] - start
+        offs = jnp.concatenate([jnp.zeros(1, deg.dtype), jnp.cumsum(deg)])
+        total = offs[-1]
+        # 2. Edge slot → queue slot: mark segment starts, prefix-sum.
+        marks = jnp.zeros(E + 1, jnp.int32).at[
+            jnp.clip(offs[:-1], 0, E)
+        ].add(1, mode="drop")
+        slot = jnp.cumsum(marks[:E]) - 1                      # (E,)
+        e_idx = jnp.arange(E, dtype=offs.dtype)
+        emask = e_idx < total
+        slot = jnp.clip(slot, 0, Q - 1)
+        edge_pos = jnp.clip(
+            start[slot] + (e_idx - offs[slot]), 0, max(self.graph.ne - 1, 0)
+        )
+        dst = dg["csr_col_dst"][edge_pos]
+        src_vals = values[jnp.clip(q[slot], 0, nv - 1)]
+        w = dg["csr_weights"][edge_pos] if "csr_weights" in dg else None
+        cand = prog.relax(src_vals, w)
+        ident = identity_for(prog.combiner, cand.dtype)
+        cand = jnp.where(emask, cand, ident)
+        dst = jnp.where(emask, dst, 0)
+        # 3. Scatter-combine candidates into the values (deterministic in
+        # XLA, unlike the reference's atomicMin, sssp_gpu.cu:48-61).
+        if prog.combiner == "min":
+            new = values.at[dst].min(cand)
+        else:
+            new = values.at[dst].max(cand)
+        new_frontier = new != values
+        return PushState(new, new_frontier), new_frontier.sum(dtype=jnp.int32)
+
+    # -- adaptive combination --------------------------------------------
+
+    def _one_iter(self, state: PushState, dg):
+        if not self.sparse:
+            return self._dense_iter(state, dg)
+        cnt = state.frontier.sum(dtype=jnp.int32)
+        # uint32 sum is exact for any total <= 2^32 > ne, so the sparse
+        # branch (only correct when total fits the edge budget) can never
+        # be selected by rounding error.
+        out_edges = jnp.where(
+            state.frontier, dg["out_degrees"].astype(jnp.uint32), 0
+        ).sum(dtype=jnp.uint32)
+        use_sparse = (cnt <= self.queue_cap) & (
+            out_edges <= jnp.uint32(self.edge_budget)
+        )
+        return jax.lax.cond(
+            use_sparse,
+            lambda st: self._sparse_iter(st, dg),
+            lambda st: self._dense_iter(st, dg),
+            state,
+        )
+
+    def _step_impl(self, state: PushState, dg):
+        return self._one_iter(state, dg)
+
+    def _chunk_impl(self, state: PushState, dg, k: int, limit=None):
+        one_iter = lambda st: self._one_iter(st, dg)
         return _chunk_while(one_iter, state, k, limit)
 
     def init_state(self, **kw) -> PushState:
@@ -165,7 +274,7 @@ class PushExecutor:
         return PushState(vals, fr)
 
     def step(self, state: PushState):
-        return self._step(state, self._col_src, self._seg_ids, self._weights)
+        return self._step(state, self._dg)
 
     def run(
         self,
@@ -184,14 +293,7 @@ class PushExecutor:
         return _run_to_fixpoint(self._multi, state, max_iters, chunk, verbose)
 
     def _multi(self, state: PushState, limit: int, k: int):
-        return self._multi_jit(
-            state,
-            self._col_src,
-            self._seg_ids,
-            self._weights,
-            jnp.int32(limit),
-            k,
-        )
+        return self._multi_jit(state, self._dg, k, limit=jnp.int32(limit))
 
     def warmup(self, chunk: int = 16, **init_kw):
         """Run one throwaway iteration through the exact run() path so

@@ -1,0 +1,19 @@
+# Convenience targets (the Python package needs no build; the native
+# library compiles itself on first use into the source-hash cache — the
+# `native` target just runs that one real build path eagerly).
+
+.PHONY: all native test bench clean
+
+all: native
+
+native:
+	python -c "from lux_tpu.native.build import load_library; load_library(); print('native library ready')"
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf build ~/.cache/lux_tpu_native

@@ -1,0 +1,84 @@
+"""Multi-host / multi-slice execution support.
+
+The reference goes multi-node by rebuilding with GASNet (`USE_GASNET=1`,
+README.md:33-37); its application code is unchanged — node count only
+folds into the partition count. The TPU equivalent keeps the same
+property: the engines only see a 1-D ``parts`` mesh, and this module is
+where that mesh comes from in distributed settings.
+
+- **single host, N chips**: `make_mesh(N)` (parallel.mesh) — ICI only.
+- **multi-host / multi-slice**: call :func:`initialize` once per process
+  (JAX's distributed runtime — the GASNet analogue), then
+  :func:`make_global_mesh`. Devices are ordered slice-major so that
+  neighboring partitions land on the same slice: the ghost-value
+  all-gather then decomposes into intra-slice ICI traffic plus a smaller
+  inter-slice DCN phase, which XLA schedules automatically from the
+  sharding (the "collectives ride ICI, not DCN" layout rule).
+
+Nothing else in the framework changes across 1 chip → v5p-64: the
+executors are SPMD over whatever mesh they're handed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from lux_tpu.parallel.mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Start JAX's distributed runtime (no-op if already initialized).
+
+    With TPU metadata available (GCE/GKE), bare ``initialize()`` suffices;
+    arguments are for manual clusters.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        msg = str(e).lower()
+        # jax 0.9: "distributed.initialize should only be called once.";
+        # older versions said "already initialized".
+        if "only be called once" not in msg and "already initialized" not in msg:
+            raise
+
+
+def make_global_mesh(num_parts: Optional[int] = None) -> Mesh:
+    """1-D ``parts`` mesh over all global devices, slice-major ordered.
+
+    ``num_parts`` may only shrink the mesh as long as every participating
+    process keeps at least one device — in multi-controller JAX all
+    processes must own a piece of the computation.
+    """
+    import jax
+
+    devices = sorted(
+        jax.devices(),
+        key=lambda d: (
+            getattr(d, "slice_index", 0) or 0,
+            d.process_index,
+            d.id,
+        ),
+    )
+    if num_parts is not None and num_parts < len(devices):
+        kept = devices[:num_parts]
+        all_procs = {d.process_index for d in devices}
+        kept_procs = {d.process_index for d in kept}
+        if kept_procs != all_procs:
+            raise ValueError(
+                f"num_parts={num_parts} would exclude every device of "
+                f"processes {sorted(all_procs - kept_procs)}; all "
+                "processes must participate in a multi-controller mesh"
+            )
+    return make_mesh(num_parts, devices=devices)

@@ -10,9 +10,25 @@ from __future__ import annotations
 
 def ensure_backend() -> str:
     """Return the platform actually in use, falling back to CPU if the
-    configured platform cannot initialize."""
+    configured platform cannot initialize. ``LUX_PLATFORM=cpu`` forces a
+    platform regardless of what the environment's sitecustomize set up
+    (JAX_PLATFORMS can be overridden before we run)."""
+    import os
+
     import jax
 
+    forced = os.environ.get("LUX_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+        got = jax.devices()[0].platform
+        if got != forced:
+            # A backend was already initialized before we ran; the config
+            # update cannot take effect retroactively.
+            raise RuntimeError(
+                f"LUX_PLATFORM={forced} requested but backend '{got}' was "
+                "already initialized; set the platform before any jax use"
+            )
+        return got
     try:
         return jax.devices()[0].platform
     except RuntimeError:

@@ -1,0 +1,111 @@
+"""End-to-end CLI tests (subprocess, forced-CPU, sharded via -parts)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import Graph, generate, write_lux
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(module, *args, timeout=180):
+    env = dict(os.environ)
+    env["LUX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", module, *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+@pytest.fixture(scope="module")
+def graphs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    g = generate.rmat(9, 8, seed=1)
+    write_lux(str(d / "g.lux"), g)
+    write_lux(str(d / "u.lux"), generate.undirected(g))
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 100, 800)
+    i = rng.integers(100, 160, 800)
+    w = rng.integers(1, 6, 800).astype(np.int32)
+    gw = Graph.from_edges(np.r_[u, i], np.r_[i, u], nv=160, weights=np.r_[w, w])
+    write_lux(str(d / "w.lux"), gw)
+    return d
+
+
+def test_cli_pagerank_check(graphs):
+    r = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout and "ELAPSED TIME" in r.stdout
+
+
+def test_cli_pagerank_sharded(graphs):
+    r = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-parts", "8", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout
+
+
+def test_cli_sssp_and_components(graphs):
+    r = run_cli(
+        "lux_tpu.models.sssp",
+        "-file", str(graphs / "u.lux"), "-start", "0", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout and "iterations =" in r.stdout
+    r = run_cli(
+        "lux_tpu.models.components",
+        "-file", str(graphs / "u.lux"), "-check", "-parts", "2",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout
+
+
+def test_cli_colfilter(graphs):
+    r = run_cli(
+        "lux_tpu.models.colfilter",
+        "-file", str(graphs / "w.lux"), "-ni", "3", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout
+
+
+def test_cli_colfilter_unweighted_graph_fails_cleanly(graphs):
+    r = run_cli(
+        "lux_tpu.models.colfilter", "-file", str(graphs / "g.lux"), "-ni", "3"
+    )
+    assert r.returncode == 1
+    assert "weighted" in r.stderr
+
+
+def test_cli_save_resume(graphs, tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    r = run_cli(
+        "lux_tpu.models.sssp",
+        "-file", str(graphs / "u.lux"), "-start", "0", "-ni", "2",
+        "-save", ck,
+    )
+    assert r.returncode == 0, r.stderr
+    r = run_cli(
+        "lux_tpu.models.sssp",
+        "-file", str(graphs / "u.lux"), "-start", "0", "-resume", ck,
+        "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout

@@ -1,0 +1,63 @@
+"""utils + parallel helpers coverage."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.parallel.mesh import make_mesh
+from lux_tpu.parallel.multihost import make_global_mesh
+from lux_tpu.utils import checkpoint
+from lux_tpu.utils.timing import Timer
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    g = generate.gnp(100, 500, seed=1)
+    vals = np.random.default_rng(0).random(g.nv).astype(np.float32)
+    fr = vals > 0.5
+    p = str(tmp_path / "ck.npz")
+    checkpoint.save(p, g, vals, 7, frontier=fr)
+    v2, it, f2 = checkpoint.load(p, g)
+    np.testing.assert_array_equal(vals, v2)
+    np.testing.assert_array_equal(fr, f2)
+    assert it == 7
+
+
+def test_checkpoint_rejects_other_graph(tmp_path):
+    g1 = generate.gnp(100, 500, seed=1)
+    g2 = generate.gnp(100, 500, seed=2)
+    p = str(tmp_path / "ck.npz")
+    checkpoint.save(p, g1, np.zeros(100, np.float32), 1)
+    with pytest.raises(ValueError):
+        checkpoint.load(p, g2)
+
+
+def test_checkpoint_without_frontier(tmp_path):
+    g = generate.gnp(50, 200, seed=3)
+    p = str(tmp_path / "ck.npz")
+    checkpoint.save(p, g, np.ones(50, np.float32), 3)
+    vals, it, fr = checkpoint.load(p, g)
+    assert fr is None and it == 3
+
+
+def test_timer():
+    with Timer() as t:
+        sum(range(1000))
+    assert t.elapsed >= 0
+
+
+def test_global_mesh_matches_local_on_single_host():
+    m1 = make_mesh(8)
+    m2 = make_global_mesh(8)
+    assert m1.devices.shape == m2.devices.shape
+    with pytest.raises(ValueError):
+        make_global_mesh(1000)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as ge
+    import jax
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == args[0].shape
+    ge.dryrun_multichip(4)

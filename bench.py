@@ -58,19 +58,35 @@ def main():
     print(f"# platform: {platform}", file=sys.stderr)
 
     g = get_graph(scale, ef, cache)
-    from lux_tpu.engine.pull import PullExecutor
+    from lux_tpu.engine.pull import PullExecutor, hard_sync
     from lux_tpu.models import PageRank
 
-    from lux_tpu.engine.pull import hard_sync
+    layout = os.environ.get("LUX_BENCH_LAYOUT", "tiled")
+    if layout not in ("tiled", "flat"):
+        raise SystemExit(f"LUX_BENCH_LAYOUT must be 'tiled' or 'flat', got {layout!r}")
+    if layout == "tiled":
+        from lux_tpu.engine.tiled import TiledPullExecutor
 
-    ex = PullExecutor(g, PageRank())
+        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "3072")) << 20
+        t0 = time.time()
+        ex = TiledPullExecutor(g, PageRank(), budget_bytes=budget)
+        print(
+            f"# tile plan: {ex.plan.num_tiles} tiles, "
+            f"coverage={ex.plan.coverage:.1%}, built in {time.time()-t0:.1f}s",
+            file=sys.stderr,
+        )
+    else:
+        ex = PullExecutor(g, PageRank())
     ex.warmup()
 
     # Timed: `iters` iterations, async-pipelined, one hard sync at the end
     # (the reference's measurement discipline, pagerank.cc:106-118;
     # hard_sync because block_until_ready returns early on tunneled
-    # backends and would fake a ~1000x speedup).
-    vals = hard_sync(ex.run(2, flush_every=0))  # settle caches
+    # backends and would fake a ~1000x speedup). The second settle run
+    # goes through the vals= path so every jitted helper (including the
+    # tiled executor's permutation converters) compiles before t0.
+    vals = hard_sync(ex.run(1, flush_every=0))
+    vals = hard_sync(ex.run(1, vals=vals, flush_every=0))
     t0 = time.perf_counter()
     vals = ex.run(iters, vals=vals, flush_every=0)
     elapsed = time.perf_counter() - t0
@@ -88,6 +104,7 @@ def main():
                 "value": round(gteps, 4),
                 "unit": "GTEPS",
                 "vs_baseline": round(gteps / PER_CHIP_BASELINE, 4),
+                "layout": layout,
             }
         )
     )

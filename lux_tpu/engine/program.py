@@ -55,6 +55,9 @@ class PullProgram:
     value_dtype = jnp.float32
     value_shape: Tuple[int, ...] = ()  # trailing per-vertex dims, e.g. (K,)
     needs_weights: bool = False
+    # True iff edge_contrib(e) == e.src_vals (an SpMV-shaped iteration);
+    # unlocks the MXU tiled-hybrid executor (engine/tiled.py).
+    identity_contrib: bool = False
 
     # -- hooks -----------------------------------------------------------
 

@@ -28,6 +28,7 @@ class PageRank(PullProgram):
     name = "pagerank"
     combiner = "sum"
     value_dtype = jnp.float32
+    identity_contrib = True  # gather side is plain old[src] (pre-divided)
 
     def init_values(self, graph) -> np.ndarray:
         rank = np.float32(1.0) / np.float32(graph.nv)

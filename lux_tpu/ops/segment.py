@@ -85,4 +85,7 @@ def segment_sum_by_rowptr(data: jnp.ndarray, row_ptr: jnp.ndarray) -> jnp.ndarra
     z = jnp.concatenate(
         [jnp.zeros((1,) + data.shape[1:], data.dtype), s], axis=0
     )
-    return z[row_ptr[1:]] - z[row_ptr[:-1]]
+    # One (nv+1)-sized gather, then a dense diff — gathers are the scalar
+    # bottleneck on TPU (~8.5 ns/elem), so don't do two of them.
+    g = z[row_ptr]
+    return g[1:] - g[:-1]

@@ -1,0 +1,253 @@
+"""Tiled-hybrid SpMV: MXU block-sparse tiles + scalar-gather tail.
+
+The pull engine's hot loop is ``acc[dst] = Σ vals[src]`` over a static
+graph (the reference's ``pr_kernel`` gather, pagerank/pagerank_gpu.cu:49-102).
+On TPU an arbitrary 1-element gather costs ~8.5 ns (scalarized), while a
+128×128 tile matmul streams from HBM at ~520 GB/s (~60 ns for a 16 KB int8
+tile) and a 128-wide row gather costs ~0.9 ns — so any 128×128 adjacency
+tile holding ≳4 edges is cheaper as a dense MXU matvec than as per-edge
+gathers.
+
+Scale-free graphs concentrate edges between high-degree vertices. After
+relabeling vertices in descending degree order, 50-60 % of an R-MAT
+graph's edges fall in 128×128 tiles with ≥16 entries (measured: RMAT22,
+62.6 % at ≥16). This module exploits that:
+
+- host side (:func:`plan_tiles`): degree-sort relabeling; count edges per
+  128×128 tile; select the densest tiles within an HBM byte budget; store
+  them as dense **int8 count tiles** (multi-edges collapse into counts;
+  cells overflowing 127 spill the excess back to the tail — exactness is
+  preserved); remaining edges become a CSC-sorted COO tail.
+- device side (:func:`tiled_spmv`): a `lax.scan` over tile chunks — row
+  gather of the source blocks, one batched (128×128)@(128×2) bf16 matmul
+  per tile (the 2 columns are a hi/lo bf16 split of the f32 operand, so
+  the result keeps ~16 mantissa bits at no extra tile bandwidth), and a
+  sorted segment-sum into destination block rows — plus the existing
+  gather + row-ptr-diff path for the tail.
+
+This is a TPU-native design with no reference counterpart: the reference
+leans on fine-grained HBM atomics (atomicAdd) that the TPU VPU simply
+does not have; the MXU *is* the TPU's gather/scatter engine for anything
+dense enough to batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.graph.graph import Graph
+
+BLOCK = 128
+CELLS = BLOCK * BLOCK
+TILE_BYTES = CELLS  # int8
+
+
+@dataclasses.dataclass(eq=False)
+class TilePlan:
+    """Host-side product of :func:`plan_tiles` (all numpy, internal ids).
+
+    "Internal" vertex ids are positions in the degree-sorted order:
+    ``order[p]`` is the external id at internal position p and
+    ``rank[v]`` is the internal position of external vertex v.
+    """
+
+    nv: int
+    nvb: int                       # number of 128-blocks (nv padded)
+    order: np.ndarray              # (nv,) int32 external id per internal pos
+    rank: np.ndarray               # (nv,) int32 internal pos per external id
+    tiles: np.ndarray              # (T, 128, 128) int8 edge counts
+    tile_row: np.ndarray           # (T,) int32 dst block, sorted
+    tile_col: np.ndarray           # (T,) int32 src block
+    tail_src: np.ndarray           # (M,) int32 internal src, CSC order
+    tail_row_ptr: np.ndarray       # (nv+1,) int64
+    out_degrees: np.ndarray        # (nv,) int64, internal order
+    in_degrees: np.ndarray         # (nv,) int64, internal order
+
+    @property
+    def num_tiles(self) -> int:
+        return int(self.tiles.shape[0])
+
+    @property
+    def coverage(self) -> float:
+        total = self.tail_src.shape[0] + int(self.tiles.sum(dtype=np.int64))
+        return 1.0 - self.tail_src.shape[0] / max(total, 1)
+
+
+def plan_tiles(
+    graph: Graph,
+    budget_bytes: int = 3 << 30,
+    min_count: int = 8,
+    reorder: str = "degree",
+) -> TilePlan:
+    """Partition a graph's edges into dense int8 count tiles + a COO tail.
+
+    Exact: every edge lands in exactly one of the two representations
+    (cells whose count exceeds int8 range spill the excess to the tail).
+    """
+    nv = graph.nv
+    nvb = (nv + BLOCK - 1) // BLOCK
+
+    if reorder == "degree":
+        deg = graph.in_degrees + graph.out_degrees
+        order = np.argsort(-deg, kind="stable").astype(np.int32)
+    elif reorder == "natural":
+        order = np.arange(nv, dtype=np.int32)
+    else:
+        raise ValueError(f"unknown reorder {reorder!r}")
+    rank = np.empty(nv, np.int32)
+    rank[order] = np.arange(nv, dtype=np.int32)
+
+    s = rank[graph.col_src].astype(np.int64)
+    d = rank[graph.col_dst].astype(np.int64)
+
+    tile_id = (d >> 7) * nvb + (s >> 7)
+    uniq_ids, counts = np.unique(tile_id, return_counts=True)
+
+    # Densest tiles first, until the byte budget or the density floor.
+    max_tiles = max(budget_bytes // TILE_BYTES, 0)
+    by_density = np.argsort(-counts, kind="stable")[:max_tiles]
+    by_density = by_density[counts[by_density] >= min_count]
+    chosen = np.sort(uniq_ids[by_density])          # ascending == (row, col) sorted
+
+    slot = np.searchsorted(chosen, tile_id)
+    covered = (slot < len(chosen))
+    if len(chosen):
+        covered &= np.equal(chosen[np.minimum(slot, len(chosen) - 1)], tile_id)
+
+    # Dense cells: count multi-edges per (tile, cell).
+    cell = ((d & 127) << 7) | (s & 127)
+    key = slot[covered] * CELLS + cell[covered]
+    uk, kc = np.unique(key, return_counts=True)
+    clipped = np.minimum(kc, 127)
+    tiles = np.zeros((len(chosen), CELLS), np.int8)
+    if len(uk):
+        tiles.ravel()[uk] = clipped.astype(np.int8)
+
+    # Spill int8 overflow back to explicit edges (rare: >127 parallel edges).
+    over = kc > 127
+    spill_s = spill_d = np.empty(0, np.int64)
+    if over.any():
+        reps = (kc[over] - 127).astype(np.int64)
+        ok = uk[over]
+        tid = chosen[ok // CELLS]
+        c = ok % CELLS
+        spill_d = np.repeat((tid // nvb) * BLOCK + (c >> 7), reps)
+        spill_s = np.repeat((tid % nvb) * BLOCK + (c & 127), reps)
+
+    tail_s = np.concatenate([s[~covered], spill_s])
+    tail_d = np.concatenate([d[~covered], spill_d])
+    tsort = np.lexsort((tail_s, tail_d))
+    tail_s = tail_s[tsort].astype(np.int32)
+    tail_row_ptr = np.zeros(nv + 1, np.int64)
+    np.cumsum(np.bincount(tail_d, minlength=nv), out=tail_row_ptr[1:])
+
+    return TilePlan(
+        nv=nv,
+        nvb=nvb,
+        order=order,
+        rank=rank,
+        tiles=tiles.reshape(-1, BLOCK, BLOCK),
+        tile_row=(chosen // nvb).astype(np.int32),
+        tile_col=(chosen % nvb).astype(np.int32),
+        tail_src=tail_s,
+        tail_row_ptr=tail_row_ptr,
+        out_degrees=graph.out_degrees[order],
+        in_degrees=graph.in_degrees[order],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device side
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeviceTiles:
+    """Tile arrays on device, chunked for the scan (zero-padded tiles are
+    harmless: zero counts contribute nothing to block row 0)."""
+
+    tiles: jnp.ndarray      # (nchunks, C, 128, 128) int8
+    rows: jnp.ndarray       # (nchunks, C) int32
+    cols: jnp.ndarray       # (nchunks, C) int32
+    nvb: int
+
+    @staticmethod
+    def build(plan: TilePlan, chunk: int = 4096, device=None) -> "DeviceTiles":
+        t, r, c = plan.tiles, plan.tile_row, plan.tile_col
+        n = t.shape[0]
+        put = lambda x: jax.device_put(jnp.asarray(x), device)
+        if n == 0:
+            # lax.scan over zero-length xs is free; don't pay for a dummy
+            # chunk of zero matmuls per iteration.
+            return DeviceTiles(
+                tiles=put(np.zeros((0, 1, BLOCK, BLOCK), np.int8)),
+                rows=put(np.zeros((0, 1), np.int32)),
+                cols=put(np.zeros((0, 1), np.int32)),
+                nvb=plan.nvb,
+            )
+        chunk = min(chunk, n)
+        pad = (-n) % chunk
+        if pad:
+            # Zero tiles contribute nothing; pad rows with the max block id
+            # so per-chunk segment ids stay sorted (indices_are_sorted).
+            t = np.concatenate([t, np.zeros((pad, BLOCK, BLOCK), np.int8)])
+            r = np.concatenate([r, np.full(pad, plan.nvb - 1, np.int32)])
+            c = np.concatenate([c, np.zeros(pad, np.int32)])
+        nchunks = t.shape[0] // chunk
+        return DeviceTiles(
+            tiles=put(t.reshape(nchunks, chunk, BLOCK, BLOCK)),
+            rows=put(r.reshape(nchunks, chunk)),
+            cols=put(c.reshape(nchunks, chunk)),
+            nvb=plan.nvb,
+        )
+
+
+def _hi_lo_split(x2d: jnp.ndarray):
+    """f32 -> two bf16 planes; hi + lo carries ~16 mantissa bits."""
+    hi = x2d.astype(jnp.bfloat16)
+    lo = (x2d - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def tiled_spmv(vals: jnp.ndarray, dt: DeviceTiles) -> jnp.ndarray:
+    """acc2d[rb] += Σ_tiles tile @ vals_block[cb]  (f32 in, f32 out).
+
+    ``vals`` is the full (nv,) f32 vector in internal order; returns the
+    (nvb*128,) accumulation (trailing pad rows are zero).
+    """
+    nvb = dt.nvb
+    pad = nvb * BLOCK - vals.shape[0]
+    x2d = jnp.pad(vals, (0, pad)).reshape(nvb, BLOCK)
+    hi, lo = _hi_lo_split(x2d)
+    xin = jnp.stack([hi, lo], axis=-1)        # (nvb, 128, 2) bf16
+
+    def body(acc, chunk):
+        tiles, rows, cols = chunk
+        xb = xin[cols]                         # (C, 128, 2) row gather
+        prod = jnp.einsum(
+            "tij,tjk->tik",
+            tiles.astype(jnp.bfloat16),
+            xb,
+            preferred_element_type=jnp.float32,
+        )                                      # (C, 128, 2)
+        contrib = prod[..., 0] + prod[..., 1]  # (C, 128) f32
+        acc = acc + jax.ops.segment_sum(
+            contrib, rows, num_segments=nvb, indices_are_sorted=True
+        )
+        return acc, None
+
+    acc0 = jnp.zeros((nvb, BLOCK), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (dt.tiles, dt.rows, dt.cols))
+    return acc.reshape(-1)
+
+
+jax.tree_util.register_dataclass(
+    DeviceTiles,
+    data_fields=["tiles", "rows", "cols"],
+    meta_fields=["nvb"],
+)

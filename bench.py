@@ -67,11 +67,12 @@ def main():
     if layout == "tiled":
         from lux_tpu.engine.tiled import TiledPullExecutor
 
-        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "3072")) << 20
+        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "6144")) << 20
         t0 = time.time()
         ex = TiledPullExecutor(g, PageRank(), budget_bytes=budget)
         print(
-            f"# tile plan: {ex.plan.num_tiles} tiles, "
+            f"# hybrid plan: {ex.plan.num_strips} strips "
+            f"({ex.plan.strip_bytes/1e9:.2f} GB), "
             f"coverage={ex.plan.coverage:.1%}, built in {time.time()-t0:.1f}s",
             file=sys.stderr,
         )

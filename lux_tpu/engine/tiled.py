@@ -1,4 +1,4 @@
-"""Tiled-hybrid pull executor: MXU tiles for hub edges, gather for the tail.
+"""Hybrid pull executor: MXU strips + lane-select tail, no scalar gathers.
 
 Drop-in alternative to :class:`lux_tpu.engine.pull.PullExecutor` for pull
 programs whose edge contribution is the source value itself
@@ -7,15 +7,15 @@ iterations like PageRank (the reference stores rank pre-divided by
 out-degree precisely so its gather side is an identity sum,
 pagerank/pagerank_gpu.cu:90-99).
 
-Internally the executor runs in degree-sorted vertex order (the tile plan's
-"internal" space) and converts at the ``run()`` boundary, so callers see
-external vertex ids exactly like the plain executor. See
-:mod:`lux_tpu.ops.tiled_spmv` for the design and measured rates.
+Internally the executor runs in degree-sorted vertex order (the plan's
+"internal" space) and converts at the public API boundary, so callers
+see external vertex ids exactly like the plain executor. See
+:mod:`lux_tpu.ops.tiled_spmv` for the layout design and measured rates.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,22 +24,27 @@ import numpy as np
 from lux_tpu.engine.program import PullProgram, VertexCtx
 from lux_tpu.engine.pull import _edge_index_dtype, hard_sync, run_pipelined
 from lux_tpu.graph.graph import Graph
-from lux_tpu.ops.segment import segment_sum_by_rowptr
-from lux_tpu.ops.tiled_spmv import DeviceTiles, TilePlan, plan_tiles, tiled_spmv
+from lux_tpu.ops.tiled_spmv import (
+    DeviceHybrid,
+    HybridPlan,
+    hybrid_spmv,
+    plan_hybrid,
+)
 
 
 class TiledPullExecutor:
-    """Executes an identity-contribution sum-combiner pull program using
-    the tiled-hybrid SpMV on a single device."""
+    """Executes an identity-contribution sum-combiner pull program via the
+    strip/lane-select hybrid SpMV on a single device."""
 
     def __init__(
         self,
         graph: Graph,
         program: PullProgram,
-        budget_bytes: int = 3 << 30,
-        min_count: int = 8,
-        chunk: int = 4096,
-        plan: Optional[TilePlan] = None,
+        levels: Sequence[Tuple[int, int]] = ((8, 4),),
+        budget_bytes: int = 6 << 30,
+        chunk_strips: int = 16384,
+        chunk_tail: int = 1 << 19,
+        plan: Optional[HybridPlan] = None,
         device=None,
     ):
         if program.combiner != "sum" or not getattr(
@@ -53,14 +58,15 @@ class TiledPullExecutor:
         self.graph = graph
         self.program = program
         self.device = device
-        self.plan = plan if plan is not None else plan_tiles(
-            graph, budget_bytes=budget_bytes, min_count=min_count
+        self.plan = plan if plan is not None else plan_hybrid(
+            graph, levels=levels, budget_bytes=budget_bytes
         )
         p = self.plan
         put = lambda x: jax.device_put(jnp.asarray(x), device)
-        self.dtiles = DeviceTiles.build(p, chunk=chunk, device=device)
+        self.dhybrid = DeviceHybrid.build(
+            p, chunk_strips=chunk_strips, chunk_tail=chunk_tail, device=device
+        )
         eidx = _edge_index_dtype(int(p.tail_row_ptr[-1]))
-        self.tail_src = put(p.tail_src)
         self.tail_row_ptr = put(p.tail_row_ptr.astype(eidx))
         self.out_degrees = put(p.out_degrees.astype(np.int32))
         self.in_degrees = put(p.in_degrees.astype(np.int32))
@@ -68,10 +74,9 @@ class TiledPullExecutor:
         self.rank = put(p.rank)     # internal position of external id
         # Device data goes through jit ARGUMENTS, never closures: a
         # closed-over array is a baked-in constant, re-uploaded with every
-        # compile request (multi-GB of tiles would break remote compile).
+        # compile request (multi-GB of strips would break remote compile).
         self._step_args = (
-            self.dtiles,
-            self.tail_src,
+            self.dhybrid,
             self.tail_row_ptr,
             self.out_degrees,
             self.in_degrees,
@@ -84,11 +89,9 @@ class TiledPullExecutor:
     # -- the jitted iteration (internal vertex order) --------------------
 
     def _step_impl(
-        self, vals, dtiles, tail_src, tail_row_ptr, out_degrees, in_degrees
+        self, vals, dhybrid, tail_row_ptr, out_degrees, in_degrees
     ) -> jnp.ndarray:
-        acc = tiled_spmv(vals, dtiles)[: self.graph.nv]
-        tail = segment_sum_by_rowptr(vals[tail_src], tail_row_ptr)
-        acc = acc + tail
+        acc = hybrid_spmv(vals, dhybrid, tail_row_ptr)
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=out_degrees,

@@ -1,97 +1,115 @@
-"""Tiled-hybrid SpMV: MXU block-sparse tiles + scalar-gather tail.
+"""Hybrid SpMV: MXU strip-tiles + a lane-select tail (no scalar gathers).
 
 The pull engine's hot loop is ``acc[dst] = Σ vals[src]`` over a static
 graph (the reference's ``pr_kernel`` gather, pagerank/pagerank_gpu.cu:49-102).
-On TPU an arbitrary 1-element gather costs ~8.5 ns (scalarized), while a
-128×128 tile matmul streams from HBM at ~520 GB/s (~60 ns for a 16 KB int8
-tile) and a 128-wide row gather costs ~0.9 ns — so any 128×128 adjacency
-tile holding ≳4 edges is cheaper as a dense MXU matvec than as per-edge
-gathers.
+Measured TPU v5e rates dictate the design:
 
-Scale-free graphs concentrate edges between high-degree vertices. After
-relabeling vertices in descending degree order, 50-60 % of an R-MAT
-graph's edges fall in 128×128 tiles with ≥16 entries (measured: RMAT22,
-62.6 % at ≥16). This module exploits that:
+- arbitrary 1-element gather: ~8.5 ns/edge (scalarized — the TPU VPU has
+  no fine-grained HBM access; this is the reference's atomicAdd/gather
+  world and the thing to design away);
+- 128-wide **row** gather: ~0.9 ns/row (~540 GB/s — full bandwidth);
+- int8 strip matmul: streams at ~520 GB/s through the MXU.
 
-- host side (:func:`plan_tiles`): degree-sort relabeling; count edges per
-  128×128 tile; select the densest tiles within an HBM byte budget; store
-  them as dense **int8 count tiles** (multi-edges collapse into counts;
-  cells overflowing 127 spill the excess back to the tail — exactness is
-  preserved); remaining edges become a CSC-sorted COO tail.
-- device side (:func:`tiled_spmv`): a `lax.scan` over tile chunks — row
-  gather of the source blocks, one batched (128×128)@(128×2) bf16 matmul
-  per tile (the 2 columns are a hi/lo bf16 split of the f32 operand, so
-  the result keeps ~16 mantissa bits at no extra tile bandwidth), and a
-  sorted segment-sum into destination block rows — plus the existing
-  gather + row-ptr-diff path for the tail.
+So the only fast irregular primitive is "fetch an aligned 128-block".
+Every edge is served by one of two such layouts:
 
-This is a TPU-native design with no reference counterpart: the reference
-leans on fine-grained HBM atomics (atomicAdd) that the TPU VPU simply
-does not have; the MXU *is* the TPU's gather/scatter engine for anything
-dense enough to batch.
+1. **Strip levels** (:class:`StripLevel`): after degree-sort relabeling,
+   hub-hub edges concentrate in (R,128) blocks of the adjacency matrix
+   (R | 128). Each dense-enough strip is stored as an (R,128) int8 count
+   matrix (multi-edges collapse into counts; cells overflowing 127 spill
+   the excess to the tail, so the edge partition stays exact) and costs
+   one row gather of the source block + one batched (R,128)@(128,2)
+   bf16 matmul — the 2 columns are a hi/lo bf16 split of the f32
+   operand, keeping ~16 mantissa bits at no extra strip bandwidth.
+   A strip of R·128 int8 bytes breaks even vs. per-edge work at about
+   R/3 edges (R=8 → ≥3 edges).
+
+2. **Lane-select tail**: a leftover edge costs one 128-wide row gather
+   of its source block plus an on-the-fly one-hot lane selection
+   (``where(lane == iota, row, 0).sum()``) — pure VPU, *exact* f32, and
+   ~512 HBM bytes/edge instead of the 4.4 KB-equivalent of a scalar
+   gather. Edges stay CSC-sorted so the per-destination reduction is
+   the scatter-free cumsum/row-ptr-diff.
+
+This layout has no reference counterpart — it is what "gather" means on
+hardware whose only irregular-access engines are aligned block DMA and
+a 128x128 systolic array.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from lux_tpu.graph.graph import Graph
+from lux_tpu.ops.segment import segment_sum_by_rowptr
 
 BLOCK = 128
-CELLS = BLOCK * BLOCK
-TILE_BYTES = CELLS  # int8
+
+
+# ---------------------------------------------------------------------------
+# Host-side planning
+# ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass(eq=False)
-class TilePlan:
-    """Host-side product of :func:`plan_tiles` (all numpy, internal ids).
+class StripLevel:
+    """Dense (r, 128) int8 count strips at one granularity."""
+
+    r: int
+    strips: np.ndarray       # (T, r, 128) int8
+    rows: np.ndarray         # (T,) int32 dst strip index (sorted ascending)
+    cols: np.ndarray         # (T,) int32 src 128-block index
+
+    @property
+    def nbytes(self) -> int:
+        return self.strips.nbytes
+
+    @property
+    def edges(self) -> int:
+        return int(self.strips.astype(np.int64).sum())
+
+
+@dataclasses.dataclass(eq=False)
+class HybridPlan:
+    """Host-side product of :func:`plan_hybrid` (numpy, internal ids).
 
     "Internal" vertex ids are positions in the degree-sorted order:
     ``order[p]`` is the external id at internal position p and
-    ``rank[v]`` is the internal position of external vertex v.
+    ``rank[v]`` the internal position of external vertex v.
     """
 
     nv: int
-    nvb: int                       # number of 128-blocks (nv padded)
-    order: np.ndarray              # (nv,) int32 external id per internal pos
-    rank: np.ndarray               # (nv,) int32 internal pos per external id
-    tiles: np.ndarray              # (T, 128, 128) int8 edge counts
-    tile_row: np.ndarray           # (T,) int32 dst block, sorted
-    tile_col: np.ndarray           # (T,) int32 src block
-    tail_src: np.ndarray           # (M,) int32 internal src, CSC order
-    tail_row_ptr: np.ndarray       # (nv+1,) int64
-    out_degrees: np.ndarray        # (nv,) int64, internal order
-    in_degrees: np.ndarray         # (nv,) int64, internal order
+    nvb: int                 # number of 128-blocks (nv padded)
+    order: np.ndarray        # (nv,) int32
+    rank: np.ndarray         # (nv,) int32
+    levels: Tuple[StripLevel, ...]
+    tail_sb: np.ndarray      # (M,) int32 src >> 7, CSC (dst-sorted) order
+    tail_lane: np.ndarray    # (M,) int8  src & 127
+    tail_row_ptr: np.ndarray  # (nv+1,) int64
+    out_degrees: np.ndarray  # (nv,) int64, internal order
+    in_degrees: np.ndarray   # (nv,) int64, internal order
 
     @property
-    def num_tiles(self) -> int:
-        return int(self.tiles.shape[0])
+    def num_strips(self) -> int:
+        return sum(lev.rows.shape[0] for lev in self.levels)
+
+    @property
+    def strip_bytes(self) -> int:
+        return sum(lev.nbytes for lev in self.levels)
 
     @property
     def coverage(self) -> float:
-        total = self.tail_src.shape[0] + int(self.tiles.sum(dtype=np.int64))
-        return 1.0 - self.tail_src.shape[0] / max(total, 1)
+        total = self.tail_sb.shape[0] + sum(lev.edges for lev in self.levels)
+        return 1.0 - self.tail_sb.shape[0] / max(total, 1)
 
 
-def plan_tiles(
-    graph: Graph,
-    budget_bytes: int = 3 << 30,
-    min_count: int = 8,
-    reorder: str = "degree",
-) -> TilePlan:
-    """Partition a graph's edges into dense int8 count tiles + a COO tail.
-
-    Exact: every edge lands in exactly one of the two representations
-    (cells whose count exceeds int8 range spill the excess to the tail).
-    """
+def _relabel(graph: Graph, reorder: str):
     nv = graph.nv
-    nvb = (nv + BLOCK - 1) // BLOCK
-
     if reorder == "degree":
         deg = graph.in_degrees + graph.out_degrees
         order = np.argsort(-deg, kind="stable").astype(np.int32)
@@ -101,60 +119,96 @@ def plan_tiles(
         raise ValueError(f"unknown reorder {reorder!r}")
     rank = np.empty(nv, np.int32)
     rank[order] = np.arange(nv, dtype=np.int32)
+    return order, rank
+
+
+def plan_hybrid(
+    graph: Graph,
+    levels: Sequence[Tuple[int, int]] = ((8, 4),),
+    budget_bytes: int = 6 << 30,
+    reorder: str = "degree",
+) -> HybridPlan:
+    """Partition edges into strip levels + a lane-select tail. Exact.
+
+    ``levels`` is a sequence of ``(r, min_count)`` pairs, consumed in
+    order: each level takes the strips (at granularity r x 128) holding
+    at least ``min_count`` still-unassigned edges, densest first, within
+    what remains of ``budget_bytes``.
+    """
+    nv = graph.nv
+    nvb = (nv + BLOCK - 1) // BLOCK
+    order, rank = _relabel(graph, reorder)
 
     s = rank[graph.col_src].astype(np.int64)
     d = rank[graph.col_dst].astype(np.int64)
+    built = []
+    remaining = budget_bytes
 
-    tile_id = (d >> 7) * nvb + (s >> 7)
-    uniq_ids, counts = np.unique(tile_id, return_counts=True)
+    for r, min_count in levels:
+        if BLOCK % r:
+            raise ValueError(f"strip height {r} must divide {BLOCK}")
+        if s.size == 0 or remaining <= 0:
+            built.append(StripLevel(
+                r=r,
+                strips=np.zeros((0, r, BLOCK), np.int8),
+                rows=np.zeros(0, np.int32),
+                cols=np.zeros(0, np.int32),
+            ))
+            continue
+        strip_bytes = r * BLOCK
+        strip_id = (d // r) * nvb + (s >> 7)
+        uniq_ids, counts = np.unique(strip_id, return_counts=True)
+        take = np.argsort(-counts, kind="stable")[: max(remaining // strip_bytes, 0)]
+        take = take[counts[take] >= min_count]
+        chosen = np.sort(uniq_ids[take])
+        slot = np.searchsorted(chosen, strip_id)
+        covered = slot < len(chosen)
+        if len(chosen):
+            covered &= np.equal(
+                chosen[np.minimum(slot, len(chosen) - 1)], strip_id
+            )
 
-    # Densest tiles first, until the byte budget or the density floor.
-    max_tiles = max(budget_bytes // TILE_BYTES, 0)
-    by_density = np.argsort(-counts, kind="stable")[:max_tiles]
-    by_density = by_density[counts[by_density] >= min_count]
-    chosen = np.sort(uniq_ids[by_density])          # ascending == (row, col) sorted
+        cell = (d % r) * BLOCK + (s & 127)
+        key = slot[covered] * strip_bytes + cell[covered]
+        uk, kc = np.unique(key, return_counts=True)
+        strips = np.zeros((len(chosen), strip_bytes), np.int8)
+        if len(uk):
+            strips.ravel()[uk] = np.minimum(kc, 127).astype(np.int8)
 
-    slot = np.searchsorted(chosen, tile_id)
-    covered = (slot < len(chosen))
-    if len(chosen):
-        covered &= np.equal(chosen[np.minimum(slot, len(chosen) - 1)], tile_id)
+        # int8 overflow (>127 parallel edges in one cell): keep the excess.
+        spill_s = spill_d = np.empty(0, np.int64)
+        over = kc > 127
+        if over.any():
+            reps = (kc[over] - 127).astype(np.int64)
+            ok = uk[over]
+            sid = chosen[ok // strip_bytes]
+            c = ok % strip_bytes
+            spill_d = np.repeat((sid // nvb) * r + c // BLOCK, reps)
+            spill_s = np.repeat((sid % nvb) * BLOCK + (c & 127), reps)
 
-    # Dense cells: count multi-edges per (tile, cell).
-    cell = ((d & 127) << 7) | (s & 127)
-    key = slot[covered] * CELLS + cell[covered]
-    uk, kc = np.unique(key, return_counts=True)
-    clipped = np.minimum(kc, 127)
-    tiles = np.zeros((len(chosen), CELLS), np.int8)
-    if len(uk):
-        tiles.ravel()[uk] = clipped.astype(np.int8)
+        built.append(StripLevel(
+            r=r,
+            strips=strips.reshape(-1, r, BLOCK),
+            rows=(chosen // nvb).astype(np.int32),
+            cols=(chosen % nvb).astype(np.int32),
+        ))
+        remaining -= strips.nbytes
+        s = np.concatenate([s[~covered], spill_s])
+        d = np.concatenate([d[~covered], spill_d])
 
-    # Spill int8 overflow back to explicit edges (rare: >127 parallel edges).
-    over = kc > 127
-    spill_s = spill_d = np.empty(0, np.int64)
-    if over.any():
-        reps = (kc[over] - 127).astype(np.int64)
-        ok = uk[over]
-        tid = chosen[ok // CELLS]
-        c = ok % CELLS
-        spill_d = np.repeat((tid // nvb) * BLOCK + (c >> 7), reps)
-        spill_s = np.repeat((tid % nvb) * BLOCK + (c & 127), reps)
-
-    tail_s = np.concatenate([s[~covered], spill_s])
-    tail_d = np.concatenate([d[~covered], spill_d])
-    tsort = np.lexsort((tail_s, tail_d))
-    tail_s = tail_s[tsort].astype(np.int32)
+    tsort = np.lexsort((s, d))
+    s, d = s[tsort], d[tsort]
     tail_row_ptr = np.zeros(nv + 1, np.int64)
-    np.cumsum(np.bincount(tail_d, minlength=nv), out=tail_row_ptr[1:])
+    np.cumsum(np.bincount(d, minlength=nv), out=tail_row_ptr[1:])
 
-    return TilePlan(
+    return HybridPlan(
         nv=nv,
         nvb=nvb,
         order=order,
         rank=rank,
-        tiles=tiles.reshape(-1, BLOCK, BLOCK),
-        tile_row=(chosen // nvb).astype(np.int32),
-        tile_col=(chosen % nvb).astype(np.int32),
-        tail_src=tail_s,
+        levels=tuple(built),
+        tail_sb=(s >> 7).astype(np.int32),
+        tail_lane=(s & 127).astype(np.int8),
         tail_row_ptr=tail_row_ptr,
         out_degrees=graph.out_degrees[order],
         in_degrees=graph.in_degrees[order],
@@ -167,42 +221,77 @@ def plan_tiles(
 
 
 @dataclasses.dataclass
-class DeviceTiles:
-    """Tile arrays on device, chunked for the scan (zero-padded tiles are
-    harmless: zero counts contribute nothing to block row 0)."""
+class DeviceLevel:
+    """One strip level on device, chunked for lax.scan (pad strips are
+    zero-count → contribute nothing; pad rows use the max strip index so
+    per-chunk segment ids stay sorted)."""
 
-    tiles: jnp.ndarray      # (nchunks, C, 128, 128) int8
+    r: int
+    strips: jnp.ndarray     # (nchunks, C, r, 128) int8
     rows: jnp.ndarray       # (nchunks, C) int32
     cols: jnp.ndarray       # (nchunks, C) int32
+
+
+@dataclasses.dataclass
+class DeviceHybrid:
+    levels: Tuple[DeviceLevel, ...]
+    tail_sb: jnp.ndarray        # (nchunks, C) int32 (padded with 0)
+    tail_lane: jnp.ndarray      # (nchunks, C) int8
     nvb: int
 
     @staticmethod
-    def build(plan: TilePlan, chunk: int = 4096, device=None) -> "DeviceTiles":
-        t, r, c = plan.tiles, plan.tile_row, plan.tile_col
-        n = t.shape[0]
+    def build(
+        plan: HybridPlan,
+        chunk_strips: int = 16384,
+        chunk_tail: int = 1 << 19,
+        device=None,
+    ) -> "DeviceHybrid":
         put = lambda x: jax.device_put(jnp.asarray(x), device)
-        if n == 0:
-            # lax.scan over zero-length xs is free; don't pay for a dummy
-            # chunk of zero matmuls per iteration.
-            return DeviceTiles(
-                tiles=put(np.zeros((0, 1, BLOCK, BLOCK), np.int8)),
-                rows=put(np.zeros((0, 1), np.int32)),
-                cols=put(np.zeros((0, 1), np.int32)),
-                nvb=plan.nvb,
+        nrb_max = lambda r: plan.nvb * (BLOCK // r) - 1
+
+        dlevels = []
+        for lev in plan.levels:
+            n = lev.rows.shape[0]
+            if n == 0:
+                dlevels.append(DeviceLevel(
+                    r=lev.r,
+                    strips=put(np.zeros((0, 1, lev.r, BLOCK), np.int8)),
+                    rows=put(np.zeros((0, 1), np.int32)),
+                    cols=put(np.zeros((0, 1), np.int32)),
+                ))
+                continue
+            c = min(chunk_strips, n)
+            pad = (-n) % c
+            st = np.concatenate(
+                [lev.strips, np.zeros((pad, lev.r, BLOCK), np.int8)]
             )
-        chunk = min(chunk, n)
-        pad = (-n) % chunk
-        if pad:
-            # Zero tiles contribute nothing; pad rows with the max block id
-            # so per-chunk segment ids stay sorted (indices_are_sorted).
-            t = np.concatenate([t, np.zeros((pad, BLOCK, BLOCK), np.int8)])
-            r = np.concatenate([r, np.full(pad, plan.nvb - 1, np.int32)])
-            c = np.concatenate([c, np.zeros(pad, np.int32)])
-        nchunks = t.shape[0] // chunk
-        return DeviceTiles(
-            tiles=put(t.reshape(nchunks, chunk, BLOCK, BLOCK)),
-            rows=put(r.reshape(nchunks, chunk)),
-            cols=put(c.reshape(nchunks, chunk)),
+            ro = np.concatenate(
+                [lev.rows, np.full(pad, nrb_max(lev.r), np.int32)]
+            )
+            co = np.concatenate([lev.cols, np.zeros(pad, np.int32)])
+            k = st.shape[0] // c
+            dlevels.append(DeviceLevel(
+                r=lev.r,
+                strips=put(st.reshape(k, c, lev.r, BLOCK)),
+                rows=put(ro.reshape(k, c)),
+                cols=put(co.reshape(k, c)),
+            ))
+
+        m = plan.tail_sb.shape[0]
+        if m == 0:
+            sb = np.zeros((0, 1), np.int32)
+            lane = np.zeros((0, 1), np.int8)
+        else:
+            c = min(chunk_tail, m)
+            pad = (-m) % c
+            sb = np.concatenate([plan.tail_sb, np.zeros(pad, np.int32)])
+            lane = np.concatenate([plan.tail_lane, np.zeros(pad, np.int8)])
+            sb = sb.reshape(-1, c)
+            lane = lane.reshape(-1, c)
+        return DeviceHybrid(
+            levels=tuple(dlevels),
+            tail_sb=put(sb),
+            tail_lane=put(lane),
             nvb=plan.nvb,
         )
 
@@ -214,40 +303,75 @@ def _hi_lo_split(x2d: jnp.ndarray):
     return hi, lo
 
 
-def tiled_spmv(vals: jnp.ndarray, dt: DeviceTiles) -> jnp.ndarray:
-    """acc2d[rb] += Σ_tiles tile @ vals_block[cb]  (f32 in, f32 out).
+def strip_level_spmv(xin: jnp.ndarray, lev: DeviceLevel, nvb: int) -> jnp.ndarray:
+    """Σ strip @ x_block per destination row; returns (nvb*128,) f32.
 
-    ``vals`` is the full (nv,) f32 vector in internal order; returns the
-    (nvb*128,) accumulation (trailing pad rows are zero).
+    ``xin`` is the (nvb, 128, 2) hi/lo bf16 operand.
     """
-    nvb = dt.nvb
-    pad = nvb * BLOCK - vals.shape[0]
-    x2d = jnp.pad(vals, (0, pad)).reshape(nvb, BLOCK)
-    hi, lo = _hi_lo_split(x2d)
-    xin = jnp.stack([hi, lo], axis=-1)        # (nvb, 128, 2) bf16
+    nrb = nvb * (BLOCK // lev.r)
 
     def body(acc, chunk):
-        tiles, rows, cols = chunk
-        xb = xin[cols]                         # (C, 128, 2) row gather
+        strips, rows, cols = chunk
+        xb = xin[cols]                                  # (C, 128, 2) row gather
         prod = jnp.einsum(
-            "tij,tjk->tik",
-            tiles.astype(jnp.bfloat16),
+            "trj,tjk->trk",
+            strips.astype(jnp.bfloat16),
             xb,
             preferred_element_type=jnp.float32,
-        )                                      # (C, 128, 2)
-        contrib = prod[..., 0] + prod[..., 1]  # (C, 128) f32
+        )                                               # (C, r, 2)
+        contrib = prod[..., 0] + prod[..., 1]           # (C, r) f32
         acc = acc + jax.ops.segment_sum(
-            contrib, rows, num_segments=nvb, indices_are_sorted=True
+            contrib, rows, num_segments=nrb, indices_are_sorted=True
         )
         return acc, None
 
-    acc0 = jnp.zeros((nvb, BLOCK), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (dt.tiles, dt.rows, dt.cols))
+    acc0 = jnp.zeros((nrb, lev.r), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (lev.strips, lev.rows, lev.cols))
     return acc.reshape(-1)
 
 
-jax.tree_util.register_dataclass(
-    DeviceTiles,
-    data_fields=["tiles", "rows", "cols"],
-    meta_fields=["nvb"],
-)
+def lane_select_tail(x2d: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
+    """Per-tail-edge source values via row gather + one-hot lane select.
+
+    Exact f32 (pure selection). Returns (M_padded,) in CSC order; pad
+    entries past the real tail length are garbage the caller's row-ptr
+    (whose last entry is the real length) never reads.
+    """
+    iota = jnp.arange(BLOCK, dtype=jnp.int32)
+
+    def body(_, chunk):
+        sb, lane = chunk
+        rows = x2d[sb]                                  # (C, 128) row gather
+        sel = jnp.where(
+            lane.astype(jnp.int32)[:, None] == iota[None, :], rows, 0.0
+        )
+        return 0, sel.sum(axis=1)
+
+    _, ys = jax.lax.scan(body, 0, (dh.tail_sb, dh.tail_lane))
+    return ys.reshape(-1)
+
+
+def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid, tail_row_ptr) -> jnp.ndarray:
+    """Full Σ vals[src] per destination over all layouts; (nv,) f32 in,
+    (nv,) f32 out (internal vertex order)."""
+    nv = vals.shape[0]
+    pad = dh.nvb * BLOCK - nv
+    x2d = jnp.pad(vals, (0, pad)).reshape(dh.nvb, BLOCK)
+    hi, lo = _hi_lo_split(x2d)
+    xin = jnp.stack([hi, lo], axis=-1)                  # (nvb, 128, 2)
+
+    acc = jnp.zeros(dh.nvb * BLOCK, jnp.float32)
+    for lev in dh.levels:
+        acc = acc + strip_level_spmv(xin, lev, dh.nvb)
+    acc = acc[:nv]
+
+    tail_vals = lane_select_tail(x2d, dh)
+    acc = acc + segment_sum_by_rowptr(tail_vals, tail_row_ptr)
+    return acc
+
+
+for _cls, _data, _meta in (
+    (DeviceLevel, ["strips", "rows", "cols"], ["r"]),
+    (DeviceHybrid, ["levels", "tail_sb", "tail_lane"], ["nvb"]),
+):
+    jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=_meta)

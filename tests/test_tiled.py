@@ -1,4 +1,4 @@
-"""Tiled-hybrid SpMV executor: plan exactness + PageRank parity."""
+"""Hybrid SpMV executor: plan exactness + PageRank parity."""
 
 import numpy as np
 import pytest
@@ -8,7 +8,7 @@ from lux_tpu.graph import generate
 from lux_tpu.graph.graph import Graph
 from lux_tpu.models.components import ConnectedComponents
 from lux_tpu.models.pagerank import PageRank, reference_pagerank
-from lux_tpu.ops.tiled_spmv import BLOCK, plan_tiles
+from lux_tpu.ops.tiled_spmv import BLOCK, plan_hybrid
 
 
 def edge_multiset(s, d):
@@ -18,23 +18,31 @@ def edge_multiset(s, d):
 def plan_edge_multiset(plan):
     """Reconstruct the (internal-id) edge multiset a plan represents."""
     edges = []
-    t = plan.tiles.astype(np.int64)
-    rows, cols = np.nonzero(t.reshape(t.shape[0], -1))
-    for slot, cell in zip(rows, cols):
-        d = plan.tile_row[slot] * BLOCK + (cell >> 7)
-        s = plan.tile_col[slot] * BLOCK + (cell & 127)
-        edges += [(int(s), int(d))] * int(t[slot].reshape(-1)[cell])
+    for lev in plan.levels:
+        if lev.strips.shape[0] == 0:
+            continue
+        t = lev.strips.astype(np.int64)
+        slots, cells = np.nonzero(t.reshape(t.shape[0], -1))
+        for slot, cell in zip(slots, cells):
+            d = lev.rows[slot] * lev.r + cell // BLOCK
+            s = lev.cols[slot] * BLOCK + (cell % BLOCK)
+            edges += [(int(s), int(d))] * int(t[slot].reshape(-1)[cell])
     tail_d = np.repeat(
         np.arange(plan.nv), np.diff(plan.tail_row_ptr).astype(np.int64)
     )
-    edges += list(zip(plan.tail_src.tolist(), tail_d.tolist()))
+    tail_s = plan.tail_sb.astype(np.int64) * BLOCK + plan.tail_lane.astype(
+        np.int64
+    )
+    edges += list(zip(tail_s.tolist(), tail_d.tolist()))
     return sorted(edges)
 
 
-@pytest.mark.parametrize("min_count", [1, 4])
-def test_plan_is_exact_partition(min_count):
+@pytest.mark.parametrize(
+    "levels", [((8, 1),), ((8, 4),), ((128, 4), (8, 2)), ((32, 2),)]
+)
+def test_plan_is_exact_partition(levels):
     g = generate.rmat(9, 8, seed=3)
-    plan = plan_tiles(g, min_count=min_count)
+    plan = plan_hybrid(g, levels=levels)
     s_int = plan.rank[g.col_src]
     d_int = plan.rank[g.col_dst]
     assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
@@ -46,68 +54,80 @@ def test_plan_spills_int8_overflow_exactly():
     src = np.concatenate([np.full(300, 2), [0, 1, 3]])
     dst = np.concatenate([np.full(300, 5), [4, 4, 4]])
     g = Graph.from_edges(src, dst, nv=8)
-    plan = plan_tiles(g, min_count=1)
+    plan = plan_hybrid(g, levels=((8, 1),))
     s_int = plan.rank[g.col_src]
     d_int = plan.rank[g.col_dst]
-    assert plan.tiles.max() == 127
+    assert max(lev.strips.max() for lev in plan.levels) == 127
     assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
 
 
 def test_plan_respects_budget_and_density_floor():
     g = generate.rmat(9, 8, seed=3)
-    plan = plan_tiles(g, budget_bytes=4 * BLOCK * BLOCK, min_count=1)
-    assert plan.num_tiles <= 4
-    plan2 = plan_tiles(g, min_count=10**9)
-    assert plan2.num_tiles == 0
-    assert plan2.tail_src.shape[0] == g.ne
+    plan = plan_hybrid(g, levels=((8, 1),), budget_bytes=4 * 8 * BLOCK)
+    assert plan.num_strips <= 4
+    plan2 = plan_hybrid(g, levels=((8, 10**9),))
+    assert plan2.num_strips == 0
+    assert plan2.tail_sb.shape[0] == g.ne
+    assert plan2.coverage == 0.0
 
 
-@pytest.mark.parametrize("min_count", [1, 8])
-def test_tiled_pagerank_parity_rmat(min_count):
+@pytest.mark.parametrize(
+    "levels", [((8, 1),), ((8, 4),), ((128, 8), (8, 2))]
+)
+def test_hybrid_pagerank_parity_rmat(levels):
     g = generate.rmat(10, 8, seed=1)
-    ex = TiledPullExecutor(g, PageRank(), min_count=min_count, chunk=16)
+    ex = TiledPullExecutor(
+        g, PageRank(), levels=levels, chunk_strips=16, chunk_tail=64
+    )
     got = np.asarray(ex.run(10))
     want = reference_pagerank(g, 10)
-    # bf16 hi/lo split keeps ~16 mantissa bits per product.
+    # bf16 hi/lo split keeps ~16 mantissa bits per strip product; the
+    # lane-select tail is exact f32.
     np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 
-def test_tiled_pagerank_parity_gnp():
+def test_hybrid_pagerank_parity_gnp():
     g = generate.gnp(500, 4000, seed=7)
-    ex = TiledPullExecutor(g, PageRank(), min_count=2, chunk=8)
+    ex = TiledPullExecutor(
+        g, PageRank(), levels=((8, 2),), chunk_strips=8, chunk_tail=128
+    )
     got = np.asarray(ex.run(10))
     want = reference_pagerank(g, 10)
     np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 
-def test_tiled_matches_plain_executor_stepwise():
+def test_hybrid_all_tail_matches_plain_executor():
     from lux_tpu.engine.pull import PullExecutor
 
     g = generate.rmat(9, 8, seed=5)
-    tex = TiledPullExecutor(g, PageRank(), min_count=1, chunk=8)
+    # min_count so high nothing tiles: pure lane-select path. Selection is
+    # exact f32, but the per-destination sums run in degree-sorted edge
+    # order, so f32 reassociation leaves ~1e-5 relative wiggle vs. the
+    # plain executor's CSC-order sums.
+    tex = TiledPullExecutor(g, PageRank(), levels=((8, 10**9),), chunk_tail=64)
     pex = PullExecutor(g, PageRank())
     a = np.asarray(tex.run(3))
     b = np.asarray(pex.run(3))
     np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-9)
 
 
-def test_tiled_run_resumes_from_external_vals():
+def test_hybrid_run_resumes_from_external_vals():
     g = generate.rmat(9, 8, seed=5)
-    ex = TiledPullExecutor(g, PageRank(), min_count=1, chunk=8)
+    ex = TiledPullExecutor(g, PageRank(), levels=((8, 1),), chunk_tail=64)
     full = np.asarray(ex.run(6))
     half = ex.run(3)
     resumed = np.asarray(ex.run(3, vals=half))
     np.testing.assert_allclose(resumed, full, rtol=1e-6)
 
 
-def test_tiled_step_and_init_speak_external_order():
+def test_hybrid_step_and_init_speak_external_order():
     # The public step()/init_values() surface must match PullExecutor's
     # (cli.py drives executors through them), despite the internal
     # degree-sorted layout.
     from lux_tpu.engine.pull import PullExecutor
 
     g = generate.rmat(9, 8, seed=11)
-    tex = TiledPullExecutor(g, PageRank(), min_count=1, chunk=8)
+    tex = TiledPullExecutor(g, PageRank(), levels=((8, 1),), chunk_tail=64)
     pex = PullExecutor(g, PageRank())
     np.testing.assert_allclose(
         np.asarray(tex.init_values()), np.asarray(pex.init_values())
@@ -117,7 +137,7 @@ def test_tiled_step_and_init_speak_external_order():
     np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-9)
 
 
-def test_tiled_rejects_non_spmv_programs():
+def test_hybrid_rejects_non_spmv_programs():
     g = generate.rmat(8, 8, seed=5)
     with pytest.raises(ValueError, match="identity|source value"):
         TiledPullExecutor(g, ConnectedComponents())

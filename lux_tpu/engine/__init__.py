@@ -1,4 +1,23 @@
 from lux_tpu.engine.program import PullProgram, EdgeCtx, VertexCtx
 from lux_tpu.engine.pull import PullExecutor
 
-__all__ = ["PullProgram", "EdgeCtx", "VertexCtx", "PullExecutor"]
+_LAZY = {
+    "TiledPullExecutor": "lux_tpu.engine.tiled",
+    "ShardedPullExecutor": "lux_tpu.engine.pull_sharded",
+    "ShardedTiledExecutor": "lux_tpu.engine.tiled_sharded",
+}
+
+__all__ = ["PullProgram", "EdgeCtx", "VertexCtx", "PullExecutor", *_LAZY]
+
+
+def __getattr__(name):
+    # Heavier executors are imported lazily to keep `import lux_tpu` light.
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(__all__)

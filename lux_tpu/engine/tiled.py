@@ -32,6 +32,19 @@ from lux_tpu.ops.tiled_spmv import (
 )
 
 
+def require_spmv_program(program: PullProgram, cls: str, fallback: str):
+    """Tiled executors only run sum-combiner programs whose edge
+    contribution is the source value (SpMV shape)."""
+    if program.combiner != "sum" or not getattr(
+        program, "identity_contrib", False
+    ):
+        raise ValueError(
+            f"{cls} requires a sum-combiner program whose "
+            f"edge contribution is the source value; {program.name} "
+            f"is not (use {fallback})"
+        )
+
+
 class TiledPullExecutor:
     """Executes an identity-contribution sum-combiner pull program via the
     strip/lane-select hybrid SpMV on a single device."""
@@ -47,14 +60,7 @@ class TiledPullExecutor:
         plan: Optional[HybridPlan] = None,
         device=None,
     ):
-        if program.combiner != "sum" or not getattr(
-            program, "identity_contrib", False
-        ):
-            raise ValueError(
-                "TiledPullExecutor requires a sum-combiner program whose "
-                f"edge contribution is the source value; {program.name} "
-                "is not (use PullExecutor)"
-            )
+        require_spmv_program(program, "TiledPullExecutor", "PullExecutor")
         self.graph = graph
         self.program = program
         self.device = device

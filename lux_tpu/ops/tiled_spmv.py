@@ -303,12 +303,14 @@ def _hi_lo_split(x2d: jnp.ndarray):
     return hi, lo
 
 
-def strip_level_spmv(xin: jnp.ndarray, lev: DeviceLevel, nvb: int) -> jnp.ndarray:
-    """Σ strip @ x_block per destination row; returns (nvb*128,) f32.
+def strip_level_spmv(xin: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarray:
+    """Σ strip @ x_block per destination row; returns (nrb*r,) f32.
 
-    ``xin`` is the (nvb, 128, 2) hi/lo bf16 operand.
+    ``xin`` is the (nvb, 128, 2) hi/lo bf16 operand; ``nrb`` is the number
+    of destination strip rows covered (``lev.cols`` may index all of
+    ``xin`` while ``lev.rows`` spans only a local destination range, which
+    is how the sharded executor reuses this kernel per shard).
     """
-    nrb = nvb * (BLOCK // lev.r)
 
     def body(acc, chunk):
         strips, rows, cols = chunk
@@ -330,12 +332,15 @@ def strip_level_spmv(xin: jnp.ndarray, lev: DeviceLevel, nvb: int) -> jnp.ndarra
     return acc.reshape(-1)
 
 
-def lane_select_tail(x2d: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
+def lane_select_tail(
+    x2d: jnp.ndarray, tail_sb: jnp.ndarray, tail_lane: jnp.ndarray
+) -> jnp.ndarray:
     """Per-tail-edge source values via row gather + one-hot lane select.
 
-    Exact f32 (pure selection). Returns (M_padded,) in CSC order; pad
-    entries past the real tail length are garbage the caller's row-ptr
-    (whose last entry is the real length) never reads.
+    Exact f32 (pure selection). ``tail_sb``/``tail_lane`` are the
+    (nchunks, C) chunked edge arrays. Returns (M_padded,) in CSC order;
+    pad entries past the real tail length are garbage the caller's
+    row-ptr (whose last entry is the real length) never reads.
     """
     iota = jnp.arange(BLOCK, dtype=jnp.int32)
 
@@ -347,7 +352,7 @@ def lane_select_tail(x2d: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
         )
         return 0, sel.sum(axis=1)
 
-    _, ys = jax.lax.scan(body, 0, (dh.tail_sb, dh.tail_lane))
+    _, ys = jax.lax.scan(body, 0, (tail_sb, tail_lane))
     return ys.reshape(-1)
 
 
@@ -362,10 +367,10 @@ def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid, tail_row_ptr) -> jnp.ndarra
 
     acc = jnp.zeros(dh.nvb * BLOCK, jnp.float32)
     for lev in dh.levels:
-        acc = acc + strip_level_spmv(xin, lev, dh.nvb)
+        acc = acc + strip_level_spmv(xin, lev, dh.nvb * (BLOCK // lev.r))
     acc = acc[:nv]
 
-    tail_vals = lane_select_tail(x2d, dh)
+    tail_vals = lane_select_tail(x2d, dh.tail_sb, dh.tail_lane)
     acc = acc + segment_sum_by_rowptr(tail_vals, tail_row_ptr)
     return acc
 

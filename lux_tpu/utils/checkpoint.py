@@ -34,7 +34,10 @@ def save(path: str, graph: Graph, values: np.ndarray, iteration: int,
     }
     if frontier is not None:
         payload["frontier"] = frontier
-    np.savez_compressed(path, **payload)
+    # Through a file object so the exact path is honored (np.savez would
+    # silently append ".npz", breaking save->resume with the same path).
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
 
 
 def load(

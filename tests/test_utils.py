@@ -22,6 +22,16 @@ def test_checkpoint_roundtrip(tmp_path):
     assert it == 7
 
 
+def test_checkpoint_honors_exact_path(tmp_path):
+    # np.savez would append ".npz" to a suffixless path, breaking the CLI
+    # save->resume cycle that passes the same -save/-resume string.
+    g = generate.gnp(50, 200, seed=3)
+    p = str(tmp_path / "ck")
+    checkpoint.save(p, g, np.ones(50, np.float32), 2)
+    vals, it, fr = checkpoint.load(p, g)
+    assert it == 2
+
+
 def test_checkpoint_rejects_other_graph(tmp_path):
     g1 = generate.gnp(100, 500, seed=1)
     g2 = generate.gnp(100, 500, seed=2)

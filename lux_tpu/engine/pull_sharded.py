@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from lux_tpu.engine.program import EdgeCtx, PullProgram, VertexCtx
-from lux_tpu.engine.pull import run_pipelined
+from lux_tpu.engine.pull import hard_sync, make_fused_runner, run_maybe_fused
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
@@ -76,6 +76,7 @@ class ShardedPullExecutor:
             out_specs=P(PARTS_AXIS),
         )
         self._step = jax.jit(mapped, donate_argnums=0)
+        self._jrun = make_fused_runner(mapped)
 
     # -- per-shard body (runs under shard_map; block shapes (1, ...)) ----
 
@@ -127,14 +128,15 @@ class ShardedPullExecutor:
         return self._step(vals, self._device_graph)
 
     def warmup(self):
-        from lux_tpu.engine.pull import hard_sync
-
         hard_sync(self.step(self.init_values()))
 
     def run(self, num_iters: int, vals=None, flush_every: int = 8):
         if vals is None:
             vals = self.init_values()
-        return run_pipelined(self.step, vals, num_iters, flush_every)
+        return run_maybe_fused(
+            self._jrun, self.step, vals, num_iters, flush_every,
+            self._device_graph,
+        )
 
     def gather_values(self, vals) -> np.ndarray:
         """Padded device layout → global (nv, *t) host array."""

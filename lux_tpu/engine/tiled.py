@@ -22,7 +22,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from lux_tpu.engine.program import PullProgram, VertexCtx
-from lux_tpu.engine.pull import _edge_index_dtype, hard_sync, run_pipelined
+from lux_tpu.engine.pull import (
+    hard_sync,
+    make_fused_runner,
+    run_maybe_fused,
+)
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.tiled_spmv import (
     DeviceHybrid,
@@ -72,8 +76,6 @@ class TiledPullExecutor:
         self.dhybrid = DeviceHybrid.build(
             p, chunk_strips=chunk_strips, chunk_tail=chunk_tail, device=device
         )
-        eidx = _edge_index_dtype(int(p.tail_row_ptr[-1]))
-        self.tail_row_ptr = put(p.tail_row_ptr.astype(eidx))
         self.out_degrees = put(p.out_degrees.astype(np.int32))
         self.in_degrees = put(p.in_degrees.astype(np.int32))
         self.order = put(p.order)   # external id at internal position
@@ -83,21 +85,21 @@ class TiledPullExecutor:
         # compile request (multi-GB of strips would break remote compile).
         self._step_args = (
             self.dhybrid,
-            self.tail_row_ptr,
             self.out_degrees,
             self.in_degrees,
         )
         self._jstep = jax.jit(self._step_impl, donate_argnums=0)
         self._step = lambda vals: self._jstep(vals, *self._step_args)
+        self._jrun = make_fused_runner(self._step_impl)
         self._to_internal = jax.jit(lambda v, order: v[order])
         self._to_external = jax.jit(lambda v, rank: v[rank])
 
     # -- the jitted iteration (internal vertex order) --------------------
 
     def _step_impl(
-        self, vals, dhybrid, tail_row_ptr, out_degrees, in_degrees
+        self, vals, dhybrid, out_degrees, in_degrees
     ) -> jnp.ndarray:
-        acc = hybrid_spmv(vals, dhybrid, tail_row_ptr)
+        acc = hybrid_spmv(vals, dhybrid)
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=out_degrees,
@@ -141,5 +143,8 @@ class TiledPullExecutor:
             internal = self._init_internal()
         else:
             internal = self._to_internal(jnp.asarray(vals), self.order)
-        internal = run_pipelined(self._step, internal, num_iters, flush_every)
+        internal = run_maybe_fused(
+            self._jrun, self._step, internal, num_iters, flush_every,
+            *self._step_args,
+        )
         return hard_sync(self._to_external(internal, self.rank))

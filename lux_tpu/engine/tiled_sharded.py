@@ -37,17 +37,24 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from lux_tpu.engine.program import PullProgram, VertexCtx
-from lux_tpu.engine.pull import _edge_index_dtype, hard_sync, run_pipelined
+from lux_tpu.engine.pull import (
+    hard_sync,
+    make_fused_runner,
+    run_maybe_fused,
+)
 from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
-from lux_tpu.ops.segment import segment_sum_by_rowptr
 from lux_tpu.ops.tiled_spmv import (
     BLOCK,
+    REBASE_STRIP,
+    REBASE_TAIL,
     DeviceLevel,
     HybridPlan,
-    _hi_lo_split,
-    lane_select_tail,
+    boundary_gather_data,
+    lane_select_tail_sums,
     plan_hybrid,
+    rebase_granularity,
+    strip_boundaries,
     strip_level_spmv,
 )
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
@@ -88,11 +95,10 @@ def partition_plan(plan: HybridPlan, num_parts: int) -> PlanPartition:
     index (see module docstring), so the dst partition only has to
     balance the tail."""
     nvb = plan.nvb
-    tail_per_v = np.diff(plan.tail_row_ptr)
-    tail_per_blk = np.zeros(nvb, np.int64)
-    np.add.at(
-        tail_per_blk, np.arange(plan.nv) // BLOCK, tail_per_v.astype(np.int64)
-    )
+    tail_per_v = np.diff(plan.tail_row_ptr).astype(np.int64)
+    tail_per_blk = np.pad(
+        tail_per_v, (0, nvb * BLOCK - plan.nv)
+    ).reshape(nvb, BLOCK).sum(axis=1)
     cost = tail_per_blk * TAIL_EDGE_COST
 
     # Per-block span term: degree-sorted order concentrates strip bytes in
@@ -137,14 +143,18 @@ class ShardedLevel:
     """One strip level, stacked per part: arrays lead with (P, nchunks, C).
 
     Strips are split across parts in equal contiguous runs of the plan's
-    (row-major sorted) strip order — NOT by destination — so row ids stay
-    GLOBAL and each part's accumulator is a partial sum over the whole
-    vertex space, merged by psum in the step."""
+    (row-major sorted) strip order — NOT by destination — so boundaries
+    stay against GLOBAL strip rows and each part's accumulator is a
+    partial sum over the whole vertex space, merged by psum in the step
+    (a part's boundary ranges clip to its local strip run; rows it
+    doesn't touch collapse to empty ranges and contribute zero)."""
 
     r: int
+    cs: int                 # rebase granularity (boundary data's chunk)
     strips: jnp.ndarray     # (P, K, C, r, 128) int8
-    rows: jnp.ndarray       # (P, K, C) int32  GLOBAL strip-row ids
     cols: jnp.ndarray       # (P, K, C) int32  GLOBAL src 128-block ids
+    bnd_blk: jnp.ndarray    # (P, nrb+1) int32 per-part boundary blocks
+    bnd_off: jnp.ndarray    # (P, nrb+1) int32 per-part boundary offsets
 
 
 @dataclasses.dataclass
@@ -152,12 +162,14 @@ class ShardedHybrid:
     levels: Tuple[ShardedLevel, ...]
     tail_sb: jnp.ndarray     # (P, K, C) int32 GLOBAL src block
     tail_lane: jnp.ndarray   # (P, K, C) int8
+    tail_cs: int             # tail rebase granularity
     max_nvb: int             # blocks per shard (padded)
 
 
 for _cls, _data, _meta in (
-    (ShardedLevel, ["strips", "rows", "cols"], ["r"]),
-    (ShardedHybrid, ["levels", "tail_sb", "tail_lane"], ["max_nvb"]),
+    (ShardedLevel, ["strips", "cols", "bnd_blk", "bnd_off"], ["r", "cs"]),
+    (ShardedHybrid, ["levels", "tail_sb", "tail_lane"],
+     ["tail_cs", "max_nvb"]),
 ):
     jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=_meta)
 
@@ -200,7 +212,7 @@ class ShardedTiledExecutor:
 
         specs = {k: P(PARTS_AXIS) for k in self._shard_args}
         # check_vma off: the scan carries inside strip_level_spmv /
-        # lane_select_tail are freshly-zeroed per-shard accumulators, which
+        # lane_select_tail_sums are freshly-zeroed per-shard accumulators, which
         # the varying-manual-axes checker would otherwise insist on seeing
         # pvary-annotated at every scan site.
         mapped = jax.shard_map(
@@ -212,6 +224,7 @@ class ShardedTiledExecutor:
         )
         jstep = jax.jit(mapped, donate_argnums=0)
         self._step = lambda vals: jstep(vals, self._shard_args, self._replicated)
+        self._jrun = make_fused_runner(mapped)
 
     # -- host-side shard construction ------------------------------------
 
@@ -229,42 +242,56 @@ class ShardedTiledExecutor:
             n = lev.rows.shape[0]
             cmax = -(-n // pcount) if n else 0
             if cmax == 0:
+                blk0, off0 = strip_boundaries(lev.rows, 1, nrb_global, lev.r)
                 slevels.append(ShardedLevel(
                     r=lev.r,
+                    cs=1,
                     strips=put(np.zeros((pcount, 0, 1, lev.r, BLOCK), np.int8)),
-                    rows=put(np.zeros((pcount, 0, 1), np.int32)),
                     cols=put(np.zeros((pcount, 0, 1), np.int32)),
+                    bnd_blk=put(np.tile(blk0, (pcount, 1))),
+                    bnd_off=put(np.tile(off0, (pcount, 1))),
                 ))
                 continue
-            # Equal contiguous runs of the sorted strip list; pad rows use
-            # the max global row id so per-chunk segment ids stay sorted,
-            # pad strips are zero counts (contribute nothing).
+            # Equal contiguous runs of the sorted strip list; pad strips
+            # are zero counts (contribute nothing). Boundaries are
+            # computed per part against its LOCAL run (searchsorted on the
+            # slice), so uncovered global rows collapse to empty ranges.
             st = np.zeros((pcount, cmax, lev.r, BLOCK), np.int8)
-            ro = np.full((pcount, cmax), nrb_global - 1, np.int32)
             co = np.zeros((pcount, cmax), np.int32)
+            c = min(chunk_strips, cmax)
+            cs = rebase_granularity(c, REBASE_STRIP) if lev.r < BLOCK else c
+            blk = np.zeros((pcount, nrb_global + 1), np.int32)
+            off = np.zeros((pcount, nrb_global + 1), np.int32)
             for p in range(pcount):
                 i0, i1 = p * cmax, min((p + 1) * cmax, n)
                 k = max(i1 - i0, 0)
                 st[p, :k] = lev.strips[i0:i1]
-                ro[p, :k] = lev.rows[i0:i1]
                 co[p, :k] = lev.cols[i0:i1]
+                blk[p], off[p] = strip_boundaries(
+                    lev.rows[i0:i1], cs, nrb_global, lev.r
+                )
             slevels.append(ShardedLevel(
                 r=lev.r,
+                cs=cs,
                 strips=put(_chunk2(st, chunk_strips, 0)),
-                rows=put(_chunk2(ro, chunk_strips, nrb_global - 1)),
                 cols=put(_chunk2(co, chunk_strips, 0)),
+                bnd_blk=put(blk),
+                bnd_off=put(off),
             ))
 
-        # Tail slices (CSC by dst => contiguous per part) + local row ptrs.
+        # Tail slices (CSC by dst => contiguous per part) + per-part
+        # static boundary gather data over the LOCAL row ptrs.
         v_lo = np.minimum(part.blk_lo * BLOCK, plan.nv)
         v_hi = np.minimum(part.blk_hi * BLOCK, plan.nv)
         e_lo = plan.tail_row_ptr[v_lo]
         e_hi = plan.tail_row_ptr[v_hi]
         mmax = max(int((e_hi - e_lo).max()), 0)
+        c_tail = min(chunk_tail, mmax) if mmax else 1
+        cs_tail = rebase_granularity(c_tail, REBASE_TAIL)
         sb = np.zeros((pcount, mmax), np.int32)
         lane = np.zeros((pcount, mmax), np.int8)
-        eidx = _edge_index_dtype(mmax)
-        rp = np.zeros((pcount, self.max_nv + 1), eidx)
+        tblk = np.zeros((pcount, self.max_nv + 1), np.int32)
+        toff = np.zeros((pcount, self.max_nv + 1), np.int32)
         deg_out = np.ones((pcount, self.max_nv), np.int64)
         deg_in = np.zeros((pcount, self.max_nv), np.int64)
         vmask = np.zeros((pcount, self.max_nv), bool)
@@ -273,10 +300,9 @@ class ShardedTiledExecutor:
             nvloc = v_hi[p] - v_lo[p]
             sb[p, :m] = plan.tail_sb[e_lo[p]:e_hi[p]]
             lane[p, :m] = plan.tail_lane[e_lo[p]:e_hi[p]]
-            rp[p, : nvloc + 1] = (
-                plan.tail_row_ptr[v_lo[p]: v_hi[p] + 1] - e_lo[p]
-            ).astype(eidx)
-            rp[p, nvloc + 1:] = m
+            rp = np.full(self.max_nv + 1, m, np.int64)
+            rp[: nvloc + 1] = plan.tail_row_ptr[v_lo[p]: v_hi[p] + 1] - e_lo[p]
+            tblk[p], toff[p] = boundary_gather_data(rp, cs_tail, 1)
             deg_out[p, :nvloc] = plan.out_degrees[v_lo[p]:v_hi[p]]
             deg_in[p, :nvloc] = plan.in_degrees[v_lo[p]:v_hi[p]]
             vmask[p, :nvloc] = True
@@ -285,10 +311,12 @@ class ShardedTiledExecutor:
             levels=tuple(slevels),
             tail_sb=put(_chunk2(sb, chunk_tail, 0)),
             tail_lane=put(_chunk2(lane, chunk_tail, 0)),
+            tail_cs=cs_tail,
             max_nvb=max_nvb,
         )
         self._shard_args = {
-            "tail_row_ptr": put(rp),
+            "tail_bnd_blk": put(tblk),
+            "tail_bnd_off": put(toff),
             "out_degrees": put(deg_out.astype(np.int32)),
             "in_degrees": put(deg_in.astype(np.int32)),
             "vertex_mask": put(vmask),
@@ -325,8 +353,6 @@ class ShardedTiledExecutor:
         v = vals_blk[0]                                   # (max_nv,) f32
         gathered = jax.lax.all_gather(v, PARTS_AXIS)      # (P, max_nv)
         x2d = gathered.reshape(-1, BLOCK)[repl["block_map"]]  # (nvb, 128)
-        hi, lo = _hi_lo_split(x2d)
-        xin = jnp.stack([hi, lo], axis=-1)
 
         # Strips: each shard sums ITS strips into a full-height partial
         # accumulator; psum merges, then the shard keeps its dst span.
@@ -334,19 +360,21 @@ class ShardedTiledExecutor:
         acc_g = jnp.zeros(nv_g, jnp.float32)
         for lev in hy.levels:
             dl = DeviceLevel(
-                r=lev.r, strips=lev.strips[0], rows=lev.rows[0],
-                cols=lev.cols[0],
+                r=lev.r, cs=lev.cs, strips=lev.strips[0], cols=lev.cols[0],
+                bnd_blk=lev.bnd_blk[0], bnd_off=lev.bnd_off[0],
             )
             acc_g = acc_g + strip_level_spmv(
-                xin, dl, self.plan.nvb * (BLOCK // lev.r)
+                x2d, dl, self.plan.nvb * (BLOCK // lev.r)
             )
         acc_g = jax.lax.psum(acc_g, PARTS_AXIS)
         start = repl["blk_lo"][jax.lax.axis_index(PARTS_AXIS)] * BLOCK
         acc = jax.lax.dynamic_slice(
             jnp.pad(acc_g, (0, self.max_nv)), (start,), (self.max_nv,)
         )
-        tail_vals = lane_select_tail(x2d, hy.tail_sb[0], hy.tail_lane[0])
-        acc = acc + segment_sum_by_rowptr(tail_vals, dg["tail_row_ptr"][0])
+        acc = acc + lane_select_tail_sums(
+            x2d, hy.tail_sb[0], hy.tail_lane[0],
+            dg["tail_bnd_blk"][0], dg["tail_bnd_off"][0], hy.tail_cs,
+        )
 
         ctx = VertexCtx(
             nv=self.graph.nv,
@@ -381,7 +409,10 @@ class ShardedTiledExecutor:
     def run(self, num_iters: int, vals=None, flush_every: int = 8):
         if vals is None:
             vals = self.init_values()
-        return run_pipelined(self._step, vals, num_iters, flush_every)
+        return run_maybe_fused(
+            self._jrun, self._step, vals, num_iters, flush_every,
+            self._shard_args, self._replicated,
+        )
 
     def gather_values(self, vals) -> np.ndarray:
         """Sharded padded internal layout -> global EXTERNAL (nv,) array."""

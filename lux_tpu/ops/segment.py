@@ -75,6 +75,39 @@ def segment_reduce(
     )
 
 
+def take1d_blocked(z: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``z[idx]`` for huge 1-D ``z`` without scalar gathers.
+
+    TPU scalar gathers run at ~8.5 ns/element (the VPU has no fine-grained
+    HBM access) while aligned 128-lane *row* gathers stream at full HBM
+    bandwidth (~0.9 ns/row, PERF.md). So: fetch the 128-block containing
+    each element as a row, then select the lane with an on-the-fly one-hot
+    — ~1.5 KB of streamed traffic per element instead of a ~4.4 KB-equiv
+    scalarized access. Exact (pure selection). Chunked with a scan so the
+    (len(idx), 128) gather/select intermediates stay bounded.
+    """
+    zz = jnp.pad(z, (0, (-z.shape[0]) % 128)).reshape(-1, 128)
+    iota = jnp.arange(128, dtype=jnp.int32)
+    n = idx.shape[0]
+    cb = min(1 << 19, n)
+    pad = (-n) % cb
+    idx_c = jnp.pad(idx, (0, pad)).reshape(-1, cb)
+
+    def body(_, ix):
+        rows = zz[(ix >> 7).astype(jnp.int32)]       # (cb, 128) row gather
+        lane = (ix & 127).astype(jnp.int32)
+        sel = jnp.where(lane[:, None] == iota[None, :], rows, 0)
+        return 0, sel.sum(axis=1)
+
+    _, out = jax.lax.scan(body, 0, idx_c)
+    return out.reshape(-1)[:n]
+
+
+# Below this many gathered elements the plain scalar gather's fixed cost
+# is noise and the blocked form's extra dense passes aren't worth it.
+_BLOCKED_GATHER_MIN = 1 << 17
+
+
 def segment_sum_by_rowptr(data: jnp.ndarray, row_ptr: jnp.ndarray) -> jnp.ndarray:
     """Sum sorted segments given CSC offsets, scatter-free.
 
@@ -86,6 +119,11 @@ def segment_sum_by_rowptr(data: jnp.ndarray, row_ptr: jnp.ndarray) -> jnp.ndarra
         [jnp.zeros((1,) + data.shape[1:], data.dtype), s], axis=0
     )
     # One (nv+1)-sized gather, then a dense diff — gathers are the scalar
-    # bottleneck on TPU (~8.5 ns/elem), so don't do two of them.
-    g = z[row_ptr]
+    # bottleneck on TPU (~8.5 ns/elem), so don't do two of them; for big
+    # 1-D inputs, do zero of them (blocked row-gather + lane select). The
+    # gate is on len(row_ptr): that is what the gather cost scales with.
+    if data.ndim == 1 and row_ptr.shape[0] >= _BLOCKED_GATHER_MIN:
+        g = take1d_blocked(z, row_ptr)
+    else:
+        g = z[row_ptr]
     return g[1:] - g[:-1]

@@ -1,0 +1,44 @@
+"""Segment-reduction ops: blocked gather kernel + rowptr sum gate."""
+
+import numpy as np
+import pytest
+
+import lux_tpu.ops.segment as seg
+
+
+def test_take1d_blocked_matches_plain_gather():
+    rng = np.random.default_rng(3)
+    z = rng.standard_normal(100_003).astype(np.float32)
+    idx = rng.integers(0, z.size, size=70_001)
+    got = np.asarray(seg.take1d_blocked(z, idx.astype(np.int32)))
+    np.testing.assert_array_equal(got, z[idx])
+
+
+def test_take1d_blocked_edge_positions():
+    z = np.arange(257, dtype=np.float32)
+    idx = np.array([0, 1, 127, 128, 129, 255, 256], np.int64)
+    got = np.asarray(seg.take1d_blocked(z, idx))
+    np.testing.assert_array_equal(got, z[idx])
+
+
+@pytest.mark.parametrize("force_blocked", [False, True])
+def test_rowptr_sum_same_result_on_both_gate_sides(
+    monkeypatch, force_blocked
+):
+    """The blocked fast path (normally gated behind 2^17 boundaries) must
+    compute exactly what the scalar-gather path computes."""
+    if force_blocked:
+        monkeypatch.setattr(seg, "_BLOCKED_GATHER_MIN", 1)
+    rng = np.random.default_rng(5)
+    nv = 300
+    counts = rng.integers(0, 9, size=nv)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    data = rng.standard_normal(int(row_ptr[-1])).astype(np.float32)
+    got = np.asarray(seg.segment_sum_by_rowptr(data, row_ptr))
+    want = np.array([
+        data[row_ptr[v]: row_ptr[v + 1]].astype(np.float64).sum()
+        for v in range(nv)
+    ])
+    # The cumsum-diff reduction's absolute error scales with the prefix
+    # magnitude (~eps * |running sum|), not the row's own sum.
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-5)

@@ -61,6 +61,13 @@ def test_plan_spills_int8_overflow_exactly():
     assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
 
 
+def test_plan_rejects_unpackable_strip_heights():
+    g = generate.rmat(9, 8, seed=3)
+    for bad in (64, 3, 256):
+        with pytest.raises(ValueError, match="strip height"):
+            plan_hybrid(g, levels=((bad, 2),))
+
+
 def test_plan_respects_budget_and_density_floor():
     g = generate.rmat(9, 8, seed=3)
     plan = plan_hybrid(g, levels=((8, 1),), budget_bytes=4 * 8 * BLOCK)
@@ -81,8 +88,9 @@ def test_hybrid_pagerank_parity_rmat(levels):
     )
     got = np.asarray(ex.run(10))
     want = reference_pagerank(g, 10)
-    # bf16 hi/lo split keeps ~16 mantissa bits per strip product; the
-    # lane-select tail is exact f32.
+    # Strip products are exact f32 (VPU mul-reduce); the per-row
+    # cumsum-diff reductions reassociate, leaving f32-roundoff wiggle.
+    # The lane-select tail is exact f32 selection.
     np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 

@@ -46,16 +46,15 @@ from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.tiled_spmv import (
     BLOCK,
-    REBASE_STRIP,
-    REBASE_TAIL,
+    GATHER_TABLE_BYTES,
     DeviceLevel,
     HybridPlan,
-    boundary_gather_data,
+    crossing_correction,
     lane_select_tail_sums,
     plan_hybrid,
-    rebase_granularity,
-    strip_boundaries,
+    round_chunk,
     strip_level_spmv,
+    zstream_boundaries,
 )
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
 
@@ -127,17 +126,6 @@ def partition_plan(plan: HybridPlan, num_parts: int) -> PlanPartition:
     )
 
 
-def _chunk2(a: np.ndarray, c: int, fill) -> np.ndarray:
-    """(P, N, ...) -> (P, nchunks, C, ...) with trailing fill padding."""
-    p, n = a.shape[0], a.shape[1]
-    c = min(c, n) if n else 1
-    pad = (-n) % c
-    if pad:
-        padding = np.full((p, pad) + a.shape[2:], fill, a.dtype)
-        a = np.concatenate([a, padding], axis=1)
-    return a.reshape((p, -1, c) + a.shape[2:])
-
-
 @dataclasses.dataclass
 class ShardedLevel:
     """One strip level, stacked per part: arrays lead with (P, nchunks, C).
@@ -147,31 +135,75 @@ class ShardedLevel:
     stay against GLOBAL strip rows and each part's accumulator is a
     partial sum over the whole vertex space, merged by psum in the step
     (a part's boundary ranges clip to its local strip run; rows it
-    doesn't touch collapse to empty ranges and contribute zero)."""
+    doesn't touch collapse to empty ranges and contribute zero).
+    Per-part crossing sets are padded to a common length with
+    (idx=0, s0=0, s1=0) no-op entries. The Z-stream is one unsegmented
+    gather table per shard (holding 1/P of the stream): P >= 4 keeps it
+    under the big-table gather cliff at RMAT22+ scale; smaller part
+    counts on huge graphs get a warning (see _warn_big_table)."""
 
     r: int
-    cs: int                 # rebase granularity (boundary data's chunk)
+    segs: tuple
     strips: jnp.ndarray     # (P, K, C, r, 128) int8
     cols: jnp.ndarray       # (P, K, C) int32  GLOBAL src 128-block ids
-    bnd_blk: jnp.ndarray    # (P, nrb+1) int32 per-part boundary blocks
-    bnd_off: jnp.ndarray    # (P, nrb+1) int32 per-part boundary offsets
+    bnd_row: jnp.ndarray    # (P, nrb+1) int32
+    bnd_grp: jnp.ndarray    # (P, nrb+1) int32
+    xing_idx: jnp.ndarray   # (P, Xmax*r) int32
+    xing_s0: jnp.ndarray    # (P, Xmax) int32
+    xing_s1: jnp.ndarray    # (P, Xmax) int32
 
 
 @dataclasses.dataclass
 class ShardedHybrid:
     levels: Tuple[ShardedLevel, ...]
-    tail_sb: jnp.ndarray     # (P, K, C) int32 GLOBAL src block
-    tail_lane: jnp.ndarray   # (P, K, C) int8
-    tail_cs: int             # tail rebase granularity
+    tail_sb: jnp.ndarray        # (P, K, C) int32 GLOBAL src block
+    tail_lane: jnp.ndarray      # (P, K, C) int8
+    tail_bnd_row: jnp.ndarray   # (P, max_nv+1) int32
+    tail_bnd_grp: jnp.ndarray   # (P, max_nv+1) int32
+    tail_xing_idx: jnp.ndarray  # (P, Xmax) int32
+    tail_xing_s0: jnp.ndarray   # (P, Xmax) int32
+    tail_xing_s1: jnp.ndarray   # (P, Xmax) int32
+    tail_segs: tuple
     max_nvb: int             # blocks per shard (padded)
 
 
 for _cls, _data, _meta in (
-    (ShardedLevel, ["strips", "cols", "bnd_blk", "bnd_off"], ["r", "cs"]),
-    (ShardedHybrid, ["levels", "tail_sb", "tail_lane"],
-     ["tail_cs", "max_nvb"]),
+    (ShardedLevel,
+     ["strips", "cols", "bnd_row", "bnd_grp",
+      "xing_idx", "xing_s0", "xing_s1"],
+     ["r", "segs"]),
+    (ShardedHybrid,
+     ["levels", "tail_sb", "tail_lane", "tail_bnd_row", "tail_bnd_grp",
+      "tail_xing_idx", "tail_xing_s0", "tail_xing_s1"],
+     ["tail_segs", "max_nvb"]),
 ):
     jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=_meta)
+
+
+def _pad_stack(arrs, width: int, dtype=np.int32) -> np.ndarray:
+    """Stack variable-length 1-D arrays into (P, width), zero-padded."""
+    out = np.zeros((len(arrs), width), dtype)
+    for p, a in enumerate(arrs):
+        out[p, : a.shape[0]] = a
+    return out
+
+
+def _warn_big_table(nrows: int, what: str):
+    """Per-shard Z-streams are single unsegmented gather tables (the
+    segment splits of the single-device path are per-part data, which
+    shard_map's one-trace-for-all-shards model can't make static); warn
+    when that table crosses the measured big-gather cliff — only small
+    part counts (P <= 2) on huge graphs get here."""
+    if nrows * BLOCK * 4 > GATHER_TABLE_BYTES:
+        import warnings
+
+        warnings.warn(
+            f"sharded {what}: per-shard boundary-extraction table is "
+            f"{nrows * BLOCK * 4 >> 20} MB, above the ~{GATHER_TABLE_BYTES >> 20} MB "
+            f"gather cliff — extraction will run ~4x off-rate; use more "
+            f"parts or the single-device executor",
+            stacklevel=3,
+        )
 
 
 class ShardedTiledExecutor:
@@ -241,42 +273,54 @@ class ShardedTiledExecutor:
             nrb_global = plan.nvb * rpb
             n = lev.rows.shape[0]
             cmax = -(-n // pcount) if n else 0
-            if cmax == 0:
-                blk0, off0 = strip_boundaries(lev.rows, 1, nrb_global, lev.r)
-                slevels.append(ShardedLevel(
-                    r=lev.r,
-                    cs=1,
-                    strips=put(np.zeros((pcount, 0, 1, lev.r, BLOCK), np.int8)),
-                    cols=put(np.zeros((pcount, 0, 1), np.int32)),
-                    bnd_blk=put(np.tile(blk0, (pcount, 1))),
-                    bnd_off=put(np.tile(off0, (pcount, 1))),
-                ))
-                continue
             # Equal contiguous runs of the sorted strip list; pad strips
             # are zero counts (contribute nothing). Boundaries are
             # computed per part against its LOCAL run (searchsorted on the
             # slice), so uncovered global rows collapse to empty ranges.
-            st = np.zeros((pcount, cmax, lev.r, BLOCK), np.int8)
-            co = np.zeros((pcount, cmax), np.int32)
-            c = min(chunk_strips, cmax)
-            cs = rebase_granularity(c, REBASE_STRIP) if lev.r < BLOCK else c
-            blk = np.zeros((pcount, nrb_global + 1), np.int32)
-            off = np.zeros((pcount, nrb_global + 1), np.int32)
+            c = round_chunk(chunk_strips, cmax, lev.r)
+            cpad = -(-max(cmax, 1) // c) * c
+            kch = cpad // c
+            # One unsegmented Z-stream table per shard (segs is static
+            # under shard_map, while per-part boundary splits are not).
+            if lev.r < BLOCK:
+                nrows = kch * (c // (BLOCK // lev.r) + 1) + 1
+                segs = ((0, nrb_global + 1, 0, nrows),)
+                _warn_big_table(nrows, f"strip level r={lev.r}")
+            else:
+                segs = ()
+            st = np.zeros((pcount, cpad, lev.r, BLOCK), np.int8)
+            co = np.zeros((pcount, cpad), np.int32)
+            row = np.zeros((pcount, nrb_global + 1), np.int32)
+            grp = np.zeros((pcount, nrb_global + 1), np.int32)
+            xis, s0s, s1s = [], [], []
             for p in range(pcount):
                 i0, i1 = p * cmax, min((p + 1) * cmax, n)
                 k = max(i1 - i0, 0)
                 st[p, :k] = lev.strips[i0:i1]
                 co[p, :k] = lev.cols[i0:i1]
-                blk[p], off[p] = strip_boundaries(
-                    lev.rows[i0:i1], cs, nrb_global, lev.r
+                b = np.searchsorted(
+                    lev.rows[i0:i1], np.arange(nrb_global + 1, dtype=np.int64)
                 )
+                if lev.r == BLOCK:
+                    kk = b // c
+                    row[p] = (kk * (c + 1) + (b - kk * c)).astype(np.int32)
+                    grp[p] = kk.astype(np.int32)
+                    xi = s0 = s1 = np.zeros(0, np.int32)
+                else:
+                    row[p], grp[p], sub = zstream_boundaries(b, c, lev.r)
+                    xi, s0, s1 = crossing_correction(sub, lev.r)
+                xis.append(xi); s0s.append(s0); s1s.append(s1)
+            xmax = max((a.shape[0] for a in s0s), default=0)
             slevels.append(ShardedLevel(
                 r=lev.r,
-                cs=cs,
-                strips=put(_chunk2(st, chunk_strips, 0)),
-                cols=put(_chunk2(co, chunk_strips, 0)),
-                bnd_blk=put(blk),
-                bnd_off=put(off),
+                segs=segs,
+                strips=put(st.reshape(pcount, kch, c, lev.r, BLOCK)),
+                cols=put(co.reshape(pcount, kch, c)),
+                bnd_row=put(row),
+                bnd_grp=put(grp),
+                xing_idx=put(_pad_stack(xis, xmax * lev.r)),
+                xing_s0=put(_pad_stack(s0s, xmax)),
+                xing_s1=put(_pad_stack(s1s, xmax)),
             ))
 
         # Tail slices (CSC by dst => contiguous per part) + per-part
@@ -286,12 +330,14 @@ class ShardedTiledExecutor:
         e_lo = plan.tail_row_ptr[v_lo]
         e_hi = plan.tail_row_ptr[v_hi]
         mmax = max(int((e_hi - e_lo).max()), 0)
-        c_tail = min(chunk_tail, mmax) if mmax else 1
-        cs_tail = rebase_granularity(c_tail, REBASE_TAIL)
-        sb = np.zeros((pcount, mmax), np.int32)
-        lane = np.zeros((pcount, mmax), np.int8)
-        tblk = np.zeros((pcount, self.max_nv + 1), np.int32)
-        toff = np.zeros((pcount, self.max_nv + 1), np.int32)
+        c_tail = round_chunk(chunk_tail, mmax, 1)
+        mpad = -(-max(mmax, 1) // c_tail) * c_tail
+        k2 = mpad // c_tail
+        sb = np.zeros((pcount, mpad), np.int32)
+        lane = np.zeros((pcount, mpad), np.int8)
+        trow = np.zeros((pcount, self.max_nv + 1), np.int32)
+        tgrp = np.zeros((pcount, self.max_nv + 1), np.int32)
+        xis, s0s, s1s = [], [], []
         deg_out = np.ones((pcount, self.max_nv), np.int64)
         deg_in = np.zeros((pcount, self.max_nv), np.int64)
         vmask = np.zeros((pcount, self.max_nv), bool)
@@ -302,21 +348,29 @@ class ShardedTiledExecutor:
             lane[p, :m] = plan.tail_lane[e_lo[p]:e_hi[p]]
             rp = np.full(self.max_nv + 1, m, np.int64)
             rp[: nvloc + 1] = plan.tail_row_ptr[v_lo[p]: v_hi[p] + 1] - e_lo[p]
-            tblk[p], toff[p] = boundary_gather_data(rp, cs_tail, 1)
+            trow[p], tgrp[p], sub = zstream_boundaries(rp, c_tail, 1)
+            xi, s0, s1 = crossing_correction(sub, 1)
+            xis.append(xi); s0s.append(s0); s1s.append(s1)
             deg_out[p, :nvloc] = plan.out_degrees[v_lo[p]:v_hi[p]]
             deg_in[p, :nvloc] = plan.in_degrees[v_lo[p]:v_hi[p]]
             vmask[p, :nvloc] = True
+        xmax = max((a.shape[0] for a in s0s), default=0)
+        cs_t = c_tail // BLOCK
+        _warn_big_table(k2 * (cs_t + 1) + 1, "tail")
 
         self.shybrid = ShardedHybrid(
             levels=tuple(slevels),
-            tail_sb=put(_chunk2(sb, chunk_tail, 0)),
-            tail_lane=put(_chunk2(lane, chunk_tail, 0)),
-            tail_cs=cs_tail,
+            tail_sb=put(sb.reshape(pcount, k2, c_tail)),
+            tail_lane=put(lane.reshape(pcount, k2, c_tail)),
+            tail_bnd_row=put(trow),
+            tail_bnd_grp=put(tgrp),
+            tail_xing_idx=put(_pad_stack(xis, xmax)),
+            tail_xing_s0=put(_pad_stack(s0s, xmax)),
+            tail_xing_s1=put(_pad_stack(s1s, xmax)),
+            tail_segs=((0, self.max_nv + 1, 0, k2 * (cs_t + 1) + 1),),
             max_nvb=max_nvb,
         )
         self._shard_args = {
-            "tail_bnd_blk": put(tblk),
-            "tail_bnd_off": put(toff),
             "out_degrees": put(deg_out.astype(np.int32)),
             "in_degrees": put(deg_in.astype(np.int32)),
             "vertex_mask": put(vmask),
@@ -360,8 +414,10 @@ class ShardedTiledExecutor:
         acc_g = jnp.zeros(nv_g, jnp.float32)
         for lev in hy.levels:
             dl = DeviceLevel(
-                r=lev.r, cs=lev.cs, strips=lev.strips[0], cols=lev.cols[0],
-                bnd_blk=lev.bnd_blk[0], bnd_off=lev.bnd_off[0],
+                r=lev.r, segs=lev.segs, strips=lev.strips[0],
+                cols=lev.cols[0], bnd_row=lev.bnd_row[0],
+                bnd_grp=lev.bnd_grp[0], xing_idx=lev.xing_idx[0],
+                xing_s0=lev.xing_s0[0], xing_s1=lev.xing_s1[0],
             )
             acc_g = acc_g + strip_level_spmv(
                 x2d, dl, self.plan.nvb * (BLOCK // lev.r)
@@ -373,7 +429,9 @@ class ShardedTiledExecutor:
         )
         acc = acc + lane_select_tail_sums(
             x2d, hy.tail_sb[0], hy.tail_lane[0],
-            dg["tail_bnd_blk"][0], dg["tail_bnd_off"][0], hy.tail_cs,
+            hy.tail_bnd_row[0], hy.tail_bnd_grp[0],
+            hy.tail_xing_idx[0], hy.tail_xing_s0[0], hy.tail_xing_s1[0],
+            hy.tail_segs,
         )
 
         ctx = VertexCtx(

@@ -86,9 +86,11 @@ def take1d_blocked(z: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     scalarized access. Exact (pure selection). Chunked with a scan so the
     (len(idx), 128) gather/select intermediates stay bounded.
     """
+    n = idx.shape[0]
+    if n == 0:
+        return z[:0]
     zz = jnp.pad(z, (0, (-z.shape[0]) % 128)).reshape(-1, 128)
     iota = jnp.arange(128, dtype=jnp.int32)
-    n = idx.shape[0]
     cb = min(1 << 19, n)
     pad = (-n) % cb
     idx_c = jnp.pad(idx, (0, pad)).reshape(-1, cb)

@@ -57,12 +57,6 @@ import numpy as np
 from lux_tpu.graph.graph import Graph
 
 BLOCK = 128
-# Default prefix-rebase granularities (see rebase_granularity /
-# pack_prefix_chunk): small enough that f32 boundary-diff error stays at
-# ~eps * (stream mass / thousands), big enough that packing overhead
-# (one P-lane group + row padding per sub-chunk) stays a few percent.
-REBASE_STRIP = 1024
-REBASE_TAIL = 4096
 
 
 # ---------------------------------------------------------------------------
@@ -159,12 +153,8 @@ def plan_hybrid(
     remaining = budget_bytes
 
     for r, min_count in levels:
-        if BLOCK % r or not (r <= 32 or r == BLOCK):
-            raise ValueError(
-                f"strip height {r} must divide {BLOCK} and be <= 32 (or"
-                f" exactly {BLOCK}): the packed prefix layout reserves 2r"
-                f" P lanes + at least one cumsum row per 128-lane block"
-            )
+        if BLOCK % r:
+            raise ValueError(f"strip height {r} must divide {BLOCK}")
         if s.size == 0 or remaining <= 0:
             built.append(StripLevel(
                 r=r,
@@ -238,16 +228,10 @@ def plan_hybrid(
 # ---------------------------------------------------------------------------
 
 
-def _rows_per_block(r: int) -> int:
-    """Local-cumsum rows packed per 128-lane block (after the 2r P lanes)."""
-    assert r <= 32 or r == BLOCK, "packed prefix layout needs r <= 32"
-    return BLOCK // r - 2
-
-
 def _dd_add(a, b):
     """Double-single (hi, lo) addition with renormalization (TwoSum).
 
-    Keeps ~2x f32 precision; used for the chunk-prefix chain so that
+    Keeps ~2x f32 precision; used for the sub-chunk-prefix chain so that
     boundary diffs of nearby prefixes cancel to ~eps^2 of stream scale
     instead of eps. Branch-free, broadcasts like +.
     """
@@ -262,199 +246,152 @@ def _dd_add(a, b):
     return hi2, lo2
 
 
-def packed_blocks_per_chunk(chunk: int, r: int) -> int:
-    return -(-(chunk + 1) // _rows_per_block(r))
+# Gathers from tables larger than this run ~4x slower on v5e (measured
+# cliff between 64 MB and 139 MB operands; an in-jit lax.slice restores
+# the fast rate), so extraction tables are split into segments below it.
+GATHER_TABLE_BYTES = 48 << 20
 
 
-def rebase_granularity(chunk: int, default: int) -> int:
-    """Sub-chunk size at which prefixes are rebased to zero.
+def _subs_per_chunk(r: int) -> int:
+    """Transposed-layout sub-chunks per scan chunk: S = 128/r lane
+    groups of width r side by side, so the per-sub-chunk cumsum runs on
+    a (cs, 128) array — cumsum on a narrow-minor-dim array is ~10x off
+    bandwidth (each of its log passes works on 128-lane tiles holding r
+    real values)."""
+    assert BLOCK % r == 0
+    return BLOCK // r
 
-    Must divide the scan chunk; falls back to chunk-level rebasing when
-    the chunk isn't a multiple of the default (small inputs, where the
-    stream mass — and with it the f32 boundary-diff error — is small
-    anyway)."""
-    return default if chunk % default == 0 else chunk
+
+def round_chunk(chunk: int, n: int, r: int) -> int:
+    """Scan chunk size: <= chunk (rounded up to a multiple of S so the
+    (C, r) contribution block transposes exactly into (cs, 128))."""
+    s = _subs_per_chunk(r)
+    return max(s, -(-min(chunk, max(n, 1)) // s) * s)
 
 
-def boundary_gather_data(b: np.ndarray, chunk: int, r: int):
-    """Static gather data for chunk-rebased prefix-pair extraction.
+# ---------------------------------------------------------------------------
+# Static (plan-time) boundary data for the Z-stream layout
+# ---------------------------------------------------------------------------
+#
+# The device scans are carry-free and emit, per chunk of C items, the
+# TRANSPOSED local cumsum: contributions (C, r) reshape to (S, cs, r)
+# with S = 128/r sub-chunks of cs = C/S items, transpose to (cs, S*r=128)
+# and cumsum along axis 0 — so lane group s of row j holds the sum of
+# the first j items of sub-chunk s. Each chunk contributes cs+1 such
+# rows (leading zero row) to the flat Z-stream, plus its S sub-chunk
+# totals to a small side stream.
+#
+# A boundary position b in [0, K*C] then maps to
+#     row = (b//C)*(cs+1) + (b%C)%cs     (one final zero row for b=K*C)
+#     grp = (b%C)//cs                    (lane group, 0..S-1)
+# and a range sum is   y[i] = Z[b_{i+1}] - Z[b_i] + (P[sub_{i+1}] -
+# P[sub_i])   where P is the double-single prefix over sub-chunk totals
+# (sub = b//cs, a GLOBAL sub-chunk index) — rebasing the cumsum to zero
+# at every sub-chunk keeps the f32 cancellation error of the Z diff at
+# sub-chunk mass. The P term is zero unless the range crosses a
+# sub-chunk start, which happens for at most n_subs of the nb output
+# rows: those corrections are applied as a tiny static scatter instead
+# of widening every gather (the dd hi/lo parts are subtracted separately
+# so prefix magnitudes cancel instead of rounding).
 
-    The device-side scans emit, per chunk of ``chunk`` items, the
-    chunk-LOCAL inclusive cumsum rows (r lanes each, with a leading zero
-    row) — prefixes are rebased to zero at every chunk start so their
-    magnitude, and hence the f32 cancellation error of a boundary diff,
-    stays at chunk scale rather than stream scale. The chunk-global part
-    (exclusive chunk prefix P_k, kept in double-single hi/lo f32 — see
-    :func:`_dd_add` — so even boundary-crossing diffs cancel to ~eps^2
-    of stream scale) rides in the SAME 128-lane block:
 
-        block = [ P_k hi (r) | P_k lo (r) | 128/r - 2 local-cumsum rows ]
-
-    so one row gather fetches all three parts (every materialized array
-    keeps a 128-wide minor dim — TPU pads narrow trailing dims to the
-    full 128-lane tile, which would inflate an interleaved narrow layout
-    by up to 64x). The P and L halves are diffed separately, so the
-    total error of a row's sum is ~eps * (sub-chunk mass) + ~eps^2 *
-    (stream mass), i.e. roundoff scales with the row's local
-    neighborhood, not the whole stream.
-
-    A sorted boundary position ``b`` (in [0, t_pad], t_pad a multiple of
-    ``chunk``) decomposes as ``k = b//chunk``, ``j = b%chunk`` and lands
-    in packed block ``k*nblk + j//rpb`` at row offset ``j%rpb``
-    (``rpb = 128/r - 2``, ``nblk = ceil((chunk+1)/rpb)``; one extra final
-    block holds the stream total for b == t_pad). Returns (block_index,
-    offset_index) int32 arrays shaped like ``b``.
-
-    For r == 128 a block has no room for P: returns (q, b//chunk) for
-    the split two-gather form (local rows are whole 128-lane blocks at
-    flat row ``q = k*(chunk+1) + j``; P is a small (K+1, 128) table
-    row-gathered by chunk index).
-    """
+def zstream_boundaries(b: np.ndarray, chunk: int, r: int):
+    """(row, grp, sub) int32/int64 arrays for sorted positions ``b``."""
     b = b.astype(np.int64)
+    s = _subs_per_chunk(r)
+    cs = chunk // s
     k = b // chunk
-    j = b - k * chunk
-    if r < BLOCK:
-        rpb = _rows_per_block(r)
-        nblk = packed_blocks_per_chunk(chunk, r)
-        blk = k * nblk + j // rpb
-        assert int(blk.max(initial=0)) < 2**31, "level too large for int32"
-        return blk.astype(np.int32), (j % rpb).astype(np.int32)
-    assert r == BLOCK
-    q = k * (chunk + 1) + j
-    assert int(q.max(initial=0)) < 2**31
-    return q.astype(np.int32), k.astype(np.int32)
+    local = b - k * chunk
+    row = k * (cs + 1) + local % cs
+    grp = local // cs
+    assert int(row.max(initial=0)) < 2**31
+    return row.astype(np.int32), grp.astype(np.int32), b // cs
 
 
-def strip_boundaries(rows: np.ndarray, chunk: int, nrb: int, r: int):
-    """Boundary gather data per dst strip-row for a sorted strip list.
+def crossing_correction(sub: np.ndarray, r: int):
+    """Static data for the sparse P-correction scatter.
+
+    ``sub`` (nb,) global sub-chunk index per boundary; output rows i with
+    sub[i+1] != sub[i] need P[sub[i+1]] - P[sub[i]] added. Returns
+    (flat output positions (|X|*r,), s0 (|X|,), s1 (|X|,)).
+    """
+    x = np.nonzero(sub[1:] != sub[:-1])[0]
+    flat = (x[:, None] * r + np.arange(r)[None, :]).ravel()
+    assert flat.size == 0 or int(flat.max()) < 2**31
+    return (
+        flat.astype(np.int32),
+        sub[x].astype(np.int32),
+        sub[x + 1].astype(np.int32),
+    )
+
+
+def split_segments(b: np.ndarray, nchunks: int, chunk: int, r: int):
+    """Cut the Z-stream into gather tables under GATHER_TABLE_BYTES.
+
+    Cuts fall on chunk boundaries (rows within one chunk interleave
+    sub-chunks, so only the chunk index is monotone in ``b``). Returns a
+    tuple of (bnd_lo, bnd_hi, row_base, row_cnt); the final zero row
+    rides with the last segment.
+    """
+    s = _subs_per_chunk(r)
+    cs = chunk // s
+    rows_per_chunk = cs + 1
+    kseg = max(GATHER_TABLE_BYTES // (BLOCK * 4) // rows_per_chunk, 1)
+    segs = []
+    for k0 in range(0, max(nchunks, 1), kseg):
+        k1 = min(k0 + kseg, nchunks)
+        lo = int(np.searchsorted(b, k0 * chunk, side="left"))
+        hi = int(np.searchsorted(b, k1 * chunk, side="left"))
+        if k1 == nchunks:
+            hi = b.shape[0]                 # include b == K*C boundaries
+        segs.append((lo, hi, k0 * rows_per_chunk,
+                     (k1 - k0) * rows_per_chunk + (1 if k1 == nchunks else 0)))
+    return tuple(segs)
+
+
+def strip_boundaries(rows: np.ndarray, nchunks: int, chunk: int, nrb: int,
+                     r: int):
+    """All static boundary data per dst strip-row for a sorted strip list.
 
     ``rows`` (n,) are the real strips' dst strip-rows, ascending; pad
     strips (indices >= n) are zero-count so any boundary <= n is exact
     against the padded scan stream. Row i's strips span ``[b[i], b[i+1])``
     with ``b = searchsorted(rows, 0..nrb)`` — all plan-time constants.
+    Returns (row, grp, xing_idx, xing_s0, xing_s1, segs).
     """
     b = np.searchsorted(rows, np.arange(nrb + 1, dtype=np.int64))
-    return boundary_gather_data(b, chunk, r)
+    if r == BLOCK:
+        # Split two-gather form: rows are whole blocks, P is a small
+        # per-chunk table indexed by b//chunk.
+        k = b // chunk
+        row = (k * (chunk + 1) + (b - k * chunk)).astype(np.int32)
+        e = np.zeros(0, np.int32)
+        return row, k.astype(np.int32), e, e, e, ()
+    row, grp, sub = zstream_boundaries(b, chunk, r)
+    xi, s0, s1 = crossing_correction(sub, r)
+    return row, grp, xi, s0, s1, split_segments(b, nchunks, chunk, r)
 
 
-def pack_prefix_chunk(contrib: jnp.ndarray, carry, cs: int):
-    """Sub-chunk-rebased cumsum + prefix packing for one scan chunk.
-
-    ``contrib`` (C, r) raw per-item contributions, ``carry`` a
-    double-single ((r,), (r,)) stream prefix at chunk start, ``cs`` the
-    rebase granularity (cs | C). Cumsums run PER SUB-CHUNK of cs items
-    (so a boundary diff's f32 cancellation error scales with sub-chunk
-    mass, not chunk or stream mass); each sub-chunk's exclusive prefix —
-    double-single, via an associative-scan of :func:`_dd_add` — rides in
-    its blocks' P lanes. Returns ((S*nblk, 128) packed blocks, new
-    carry), laid out per :func:`boundary_gather_data` with chunk=cs.
-    """
-    c, r = contrib.shape
-    s = c // cs
-    rpb = _rows_per_block(r)
-    nblk = packed_blocks_per_chunk(cs, r)
-    s_sub = jnp.cumsum(contrib.reshape(s, cs, r), axis=1)
-    totals = s_sub[:, -1, :]                             # (S, r)
-    tp_hi, tp_lo = jax.lax.associative_scan(
-        _dd_add, (totals, jnp.zeros_like(totals)), axis=0
-    )
-    z1 = jnp.zeros((1, r), jnp.float32)
-    excl = (
-        jnp.concatenate([z1, tp_hi[:-1]]),
-        jnp.concatenate([z1, tp_lo[:-1]]),
-    )
-    p_hi, p_lo = _dd_add((carry[0][None, :], carry[1][None, :]), excl)
-    new_carry = _dd_add(carry, (tp_hi[-1], tp_lo[-1]))
-    lrows = jnp.concatenate([z1[None].repeat(s, 0), s_sub], axis=1)
-    lrows = jnp.pad(lrows, ((0, 0), (0, nblk * rpb - (cs + 1)), (0, 0)))
-    lpart = lrows.reshape(s, nblk, rpb * r)
-    phi = jnp.broadcast_to(p_hi[:, None, :], (s, nblk, r))
-    plo = jnp.broadcast_to(p_lo[:, None, :], (s, nblk, r))
-    packed = jnp.concatenate([phi, plo, lpart], axis=2)  # (S, nblk, 128)
-    return packed.reshape(s * nblk, BLOCK), new_carry
-
-
-def prefix_pair_extract(
-    packed: jnp.ndarray,
-    pk: jnp.ndarray,
-    carry,
-    bnd_blk: jnp.ndarray,
-    bnd_off: jnp.ndarray,
-    r: int,
-) -> jnp.ndarray:
-    """Boundary-range sums from a chunk-rebased scan's stacked outputs.
-
-    ``packed`` (K, S*nblk, 128) stacked :func:`pack_prefix_chunk` blocks
-    (for r < 128), or (K, C+1, 128) raw local-cumsum rows for r == 128;
-    ``pk`` (K, 128) exclusive chunk prefixes (used only for r == 128);
-    ``carry`` is the stream total — a double-single ((r,), (r,)) pair
-    for r < 128, a plain (128,) array for r == 128. Returns the flat
-    (len(bnd)-1)*r per-range sums via the static boundary data of
-    :func:`boundary_gather_data`. The P-hi, P-lo and L parts are diffed
-    SEPARATELY (in flat 1-D space, ``g[r:] - g[:-r]``) so prefix
-    magnitudes cancel instead of rounding.
-    """
-    nb = bnd_blk.shape[0]
-    if r < BLOCK:
-        final = jnp.concatenate(
-            [carry[0], carry[1], jnp.zeros((BLOCK - 2 * r,), jnp.float32)]
-        )
-        flat = jnp.concatenate([packed.reshape(-1, BLOCK), final[None]])
-        rpb = _rows_per_block(r)
-        iota_w = jnp.arange(rpb, dtype=jnp.int32)
-
-        # Chunked extraction: one shot would materialize (nb, 128) f32
-        # gather/select intermediates (nb can be nv+1 — gigabytes); the
-        # scan bounds them at (cb, 128).
-        cb = min(1 << 19, nb)
-        pad = (-nb) % cb
-        blk_c = jnp.pad(bnd_blk, (0, pad)).reshape(-1, cb)
-        off_c = jnp.pad(bnd_off, (0, pad)).reshape(-1, cb)
-
-        def ebody(_, ch):
-            blk, off = ch
-            rw = flat[blk]                               # (cb, 128)
-            gph = rw[:, :r]
-            gpl = rw[:, r: 2 * r]
-            rl = rw[:, 2 * r:].reshape(-1, rpb, r)
-            sel = off[:, None] == iota_w[None, :]
-            gl = jnp.where(sel[:, :, None], rl, 0.0).sum(axis=1)
-            # 1-D outputs: no narrow-minor-dim lane padding
-            return 0, (gph.reshape(-1), gpl.reshape(-1), gl.reshape(-1))
-
-        _, (gph, gpl, gl) = jax.lax.scan(ebody, 0, (blk_c, off_c))
-        gph = gph.reshape(-1)[: nb * r]
-        gpl = gpl.reshape(-1)[: nb * r]
-        gl = gl.reshape(-1)[: nb * r]
-        # Diff each part separately: hi parts of nearby prefixes cancel
-        # (often exactly, Sterbenz); lo parts carry the residual.
-        return (
-            (gph[r:] - gph[:-r])
-            + (gpl[r:] - gpl[:-r])
-            + (gl[r:] - gl[:-r])
-        )
-    # r == 128: split two-gather form (chunk-level rebase only)
-    lf = jnp.concatenate(
-        [packed.reshape(-1, BLOCK), jnp.zeros((1, BLOCK), jnp.float32)]
-    )
-    pp = jnp.concatenate([pk, carry[None]])              # (K+1, 128)
-    gl = lf[bnd_blk].reshape(-1)
-    gp = pp[bnd_off].reshape(-1)                         # bnd_off holds b//chunk
-    return (gp[r:] - gp[:-r]) + (gl[r:] - gl[:-r])
+# ---------------------------------------------------------------------------
+# Device data + kernels
+# ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass
 class DeviceLevel:
     """One strip level on device, chunked for lax.scan (pad strips are
-    zero-count → contribute nothing). ``bnd_blk``/``bnd_off`` are the
-    static boundary gather data from :func:`strip_boundaries`."""
+    zero-count → contribute nothing). Boundary fields are the static
+    Z-stream data from :func:`strip_boundaries`."""
 
     r: int
-    cs: int                 # rebase granularity (boundary data's chunk)
+    segs: tuple             # static gather-table segmentation
     strips: jnp.ndarray     # (nchunks, C, r, 128) int8
     cols: jnp.ndarray       # (nchunks, C) int32
-    bnd_blk: jnp.ndarray    # (nrb+1,) int32
-    bnd_off: jnp.ndarray    # (nrb+1,) int32
+    bnd_row: jnp.ndarray    # (nrb+1,) int32
+    bnd_grp: jnp.ndarray    # (nrb+1,) int32
+    xing_idx: jnp.ndarray   # (|X|*r,) int32 flat output positions
+    xing_s0: jnp.ndarray    # (|X|,) int32
+    xing_s1: jnp.ndarray    # (|X|,) int32
 
 
 @dataclasses.dataclass
@@ -462,9 +399,12 @@ class DeviceHybrid:
     levels: Tuple[DeviceLevel, ...]
     tail_sb: jnp.ndarray        # (nchunks, C) int32 (padded with 0)
     tail_lane: jnp.ndarray      # (nchunks, C) int8
-    tail_bnd_blk: jnp.ndarray   # (nv+1,) int32 (tail_row_ptr boundaries)
-    tail_bnd_off: jnp.ndarray   # (nv+1,) int32
-    tail_cs: int                # tail rebase granularity
+    tail_bnd_row: jnp.ndarray   # (nv+1,) int32 (tail_row_ptr boundaries)
+    tail_bnd_grp: jnp.ndarray   # (nv+1,) int32
+    tail_xing_idx: jnp.ndarray  # (|X|,) int32
+    tail_xing_s0: jnp.ndarray   # (|X|,) int32
+    tail_xing_s1: jnp.ndarray   # (|X|,) int32
+    tail_segs: tuple
     nvb: int
 
     @staticmethod
@@ -480,58 +420,121 @@ class DeviceHybrid:
         for lev in plan.levels:
             nrb = plan.nvb * (BLOCK // lev.r)
             n = lev.rows.shape[0]
-            if n == 0:
-                blk, off = strip_boundaries(lev.rows, 1, nrb, lev.r)
-                dlevels.append(DeviceLevel(
-                    r=lev.r,
-                    cs=1,
-                    strips=put(np.zeros((0, 1, lev.r, BLOCK), np.int8)),
-                    cols=put(np.zeros((0, 1), np.int32)),
-                    bnd_blk=put(blk),
-                    bnd_off=put(off),
-                ))
-                continue
-            c = min(chunk_strips, n)
+            c = round_chunk(chunk_strips, n, lev.r)
             pad = (-n) % c
             st = np.concatenate(
                 [lev.strips, np.zeros((pad, lev.r, BLOCK), np.int8)]
             )
-            co = np.concatenate([lev.cols, np.zeros(pad, np.int32)])
+            co = np.concatenate(
+                [lev.cols.astype(np.int32), np.zeros(pad, np.int32)]
+            )
             k = st.shape[0] // c
-            cs = rebase_granularity(c, REBASE_STRIP) if lev.r < BLOCK else c
-            blk, off = strip_boundaries(lev.rows, cs, nrb, lev.r)
+            row, grp, xi, s0, s1, segs = strip_boundaries(
+                lev.rows, k, c, nrb, lev.r
+            )
             dlevels.append(DeviceLevel(
                 r=lev.r,
-                cs=cs,
+                segs=segs,
                 strips=put(st.reshape(k, c, lev.r, BLOCK)),
                 cols=put(co.reshape(k, c)),
-                bnd_blk=put(blk),
-                bnd_off=put(off),
+                bnd_row=put(row),
+                bnd_grp=put(grp),
+                xing_idx=put(xi),
+                xing_s0=put(s0),
+                xing_s1=put(s1),
             ))
 
         m = plan.tail_sb.shape[0]
-        if m == 0:
-            sb = np.zeros((0, 1), np.int32)
-            lane = np.zeros((0, 1), np.int8)
-            c = 1
-        else:
-            c = min(chunk_tail, m)
-            pad = (-m) % c
-            sb = np.concatenate([plan.tail_sb, np.zeros(pad, np.int32)])
-            lane = np.concatenate([plan.tail_lane, np.zeros(pad, np.int8)])
-            sb = sb.reshape(-1, c)
-            lane = lane.reshape(-1, c)
-        tail_cs = rebase_granularity(c, REBASE_TAIL)
-        tblk, toff = boundary_gather_data(plan.tail_row_ptr, tail_cs, 1)
+        c = round_chunk(chunk_tail, m, 1)
+        pad = (-m) % c
+        sb = np.concatenate([plan.tail_sb, np.zeros(pad, np.int32)])
+        lane = np.concatenate([plan.tail_lane, np.zeros(pad, np.int8)])
+        k2 = sb.shape[0] // c
+        row, grp, sub = zstream_boundaries(plan.tail_row_ptr, c, 1)
+        xi, s0, s1 = crossing_correction(sub, 1)
         return DeviceHybrid(
             levels=tuple(dlevels),
-            tail_sb=put(sb),
-            tail_lane=put(lane),
-            tail_bnd_blk=put(tblk),
-            tail_bnd_off=put(toff),
-            tail_cs=tail_cs,
+            tail_sb=put(sb.reshape(k2, c)),
+            tail_lane=put(lane.reshape(k2, c)),
+            tail_bnd_row=put(row),
+            tail_bnd_grp=put(grp),
+            tail_xing_idx=put(xi),
+            tail_xing_s0=put(s0),
+            tail_xing_s1=put(s1),
+            tail_segs=split_segments(plan.tail_row_ptr, k2, c, 1),
             nvb=plan.nvb,
         )
+
+
+def _transpose_cumsum(contrib: jnp.ndarray):
+    """(C, r) contributions → ((cs+1, 128) Z rows, (S, r) sub totals).
+
+    The transpose puts S = 128/r sub-chunks side by side so the cumsum's
+    minor dim is exactly 128 (a (S, cs, r) axis-1 cumsum measured ~10x
+    slower — every log-pass touches 128-lane tiles holding r values).
+    """
+    c, r = contrib.shape
+    s = _subs_per_chunk(r)
+    cs = c // s
+    zt = contrib.reshape(s, cs, r).transpose(1, 0, 2).reshape(cs, BLOCK)
+    z = jnp.cumsum(zt, axis=0)
+    zrows = jnp.concatenate([jnp.zeros((1, BLOCK), jnp.float32), z])
+    return zrows, z[-1].reshape(s, r)
+
+
+def _dd_prefix(totals_flat: jnp.ndarray):
+    """(n_subs, r) sub totals → exclusive double-single prefix tables
+    (n_subs+1, r) hi and lo."""
+    n, r = totals_flat.shape
+    z1 = jnp.zeros((1, r), jnp.float32)
+    if n == 0:
+        return z1, z1
+    hi, lo = jax.lax.associative_scan(
+        _dd_add, (totals_flat, jnp.zeros_like(totals_flat)), axis=0
+    )
+    return (
+        jnp.concatenate([z1, hi]),
+        jnp.concatenate([z1, lo]),
+    )
+
+
+def zstream_extract(
+    flatz: jnp.ndarray,
+    lev_r: int,
+    segs,
+    bnd_row: jnp.ndarray,
+    bnd_grp: jnp.ndarray,
+) -> jnp.ndarray:
+    """Gather Z values at static boundaries; returns flat (nb*r,) f32.
+
+    Gathers run per segment against an in-jit slice of the stream (big
+    gather tables are ~4x off-rate, GATHER_TABLE_BYTES) and are chunked
+    with a scan so the (cb, 128) intermediates stay bounded.
+    """
+    r = lev_r
+    s = _subs_per_chunk(r)
+    iota_s = jnp.arange(s, dtype=jnp.int32)
+    outs = []
+    for (lo, hi, base, cnt) in segs:
+        nbs = hi - lo
+        if nbs == 0:
+            continue
+        sub_tbl = jax.lax.slice(flatz, (base, 0), (base + cnt, BLOCK))
+        cb = min(1 << 19, nbs)
+        pad = (-nbs) % cb
+        idx = jnp.pad(bnd_row[lo:hi] - base, (0, pad)).reshape(-1, cb)
+        grp = jnp.pad(bnd_grp[lo:hi], (0, pad)).reshape(-1, cb)
+
+        def ebody(_, ch):
+            ix, g = ch
+            rw = sub_tbl[ix].reshape(-1, s, r)           # (cb, S, r)
+            sel = g[:, None] == iota_s[None, :]
+            gv = jnp.where(sel[:, :, None], rw, 0.0).sum(axis=1)
+            return 0, gv.reshape(-1)                     # 1-D: no lane pad
+
+        _, gv = jax.lax.scan(ebody, 0, (idx, grp))
+        outs.append(gv.reshape(-1)[: nbs * r])
+    return jnp.concatenate(outs)
 
 
 def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarray:
@@ -545,9 +548,9 @@ def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarra
 
     Per-strip contributions are an f32 broadcast-multiply-reduce on the
     VPU (int8 counts convert in-fusion). The per-row reduction is
-    scatter-free: chunk-rebased prefix pairs plus a diff at the static
-    row boundaries (see :func:`boundary_gather_data` for layout and
-    error analysis); products themselves are exact f32.
+    scatter-free: transposed sub-chunk cumsums (carry-free scan) + static
+    boundary diffs + the sparse double-single P correction — see the
+    Z-stream layout notes above; products themselves are exact f32.
     """
     r = lev.r
 
@@ -556,64 +559,88 @@ def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarra
         xb = x2d[cols]                                  # (C, 128) row gather
         return (strips.astype(jnp.float32) * xb[:, None, :]).sum(-1)
 
-    if r < BLOCK:
+    if r == BLOCK:
+        # Split two-gather form: a (C+1, 128) local-cumsum block per
+        # chunk + a small (K+1, 128) chunk-prefix table (chunk-level
+        # rebase only — r=128 levels are small hub tiles).
         def body(carry, chunk):
-            out, ncarry = pack_prefix_chunk(contrib_of(chunk), carry, lev.cs)
-            return ncarry, out
-
-        zr = jnp.zeros((r,), jnp.float32)
-        carry, packed = jax.lax.scan(
-            body, (zr, zr), (lev.strips, lev.cols)
-        )
-        pk = None
-    else:
-        def body(carry, chunk):
-            s_loc = jnp.cumsum(contrib_of(chunk), axis=0)   # (C, 128)
+            s_loc = jnp.cumsum(contrib_of(chunk), axis=0)
             out = jnp.concatenate(
                 [jnp.zeros((1, r), jnp.float32), s_loc]
             )
             return carry + s_loc[-1], (out, carry)
 
-        carry, (packed, pk) = jax.lax.scan(
+        carry, (z, pk) = jax.lax.scan(
             body, jnp.zeros((r,), jnp.float32), (lev.strips, lev.cols)
         )
-    return prefix_pair_extract(
-        packed, pk, carry, lev.bnd_blk, lev.bnd_off, r
+        lf = jnp.concatenate(
+            [z.reshape(-1, BLOCK), jnp.zeros((1, BLOCK), jnp.float32)]
+        )
+        pp = jnp.concatenate([pk, carry[None]])          # (K+1, 128)
+        gl = lf[lev.bnd_row].reshape(-1)
+        gp = pp[lev.bnd_grp].reshape(-1)
+        return (gp[r:] - gp[:-r]) + (gl[r:] - gl[:-r])
+
+    def body(_, chunk):
+        zrows, totals = _transpose_cumsum(contrib_of(chunk))
+        return 0, (zrows, totals)
+
+    _, (z, totals) = jax.lax.scan(body, 0, (lev.strips, lev.cols))
+    flatz = jnp.concatenate(
+        [z.reshape(-1, BLOCK), jnp.zeros((1, BLOCK), jnp.float32)]
     )
+    gl = zstream_extract(flatz, r, lev.segs, lev.bnd_row, lev.bnd_grp)
+    y = gl[r:] - gl[:-r]
+    ph, pl = _dd_prefix(totals.reshape(-1, r))
+    corr = (
+        (ph[lev.xing_s1] - ph[lev.xing_s0])
+        + (pl[lev.xing_s1] - pl[lev.xing_s0])
+    )
+    return y.at[lev.xing_idx].add(corr.reshape(-1))
 
 
 def lane_select_tail_sums(
     x2d: jnp.ndarray,
     tail_sb: jnp.ndarray,
     tail_lane: jnp.ndarray,
-    bnd_blk: jnp.ndarray,
-    bnd_off: jnp.ndarray,
-    cs: int,
+    bnd_row: jnp.ndarray,
+    bnd_grp: jnp.ndarray,
+    xing_idx: jnp.ndarray,
+    xing_s0: jnp.ndarray,
+    xing_s1: jnp.ndarray,
+    segs,
 ) -> jnp.ndarray:
     """Per-destination sums of tail-edge source values, fused.
 
     Each tail edge costs one 128-wide row gather of its source block plus
     an on-the-fly one-hot lane selection (exact f32). The per-destination
-    reduction needs no scatter and no stream-scale cumsum: the scan emits
-    chunk-rebased prefix pairs and the static ``tail_row_ptr`` boundaries
-    (``bnd_blk``/``bnd_off`` from :func:`boundary_gather_data` at r=1)
-    are diffed out. Pad edges past the real tail length land after the
-    last boundary and are never read. Returns (nv,) f32.
+    reduction is the Z-stream boundary diff at the static
+    ``tail_row_ptr`` boundaries (r=1) + the sparse double-single P
+    correction. Pad edges past the real tail length land after the last
+    boundary and are never read. Returns (nv,) f32.
     """
     iota = jnp.arange(BLOCK, dtype=jnp.int32)
 
-    def body(carry, chunk):
+    def body(_, chunk):
         sb, lane = chunk
         rows = x2d[sb]                                  # (C, 128) row gather
         v = jnp.where(
             lane.astype(jnp.int32)[:, None] == iota[None, :], rows, 0.0
         ).sum(axis=1)                                   # (C,)
-        out, ncarry = pack_prefix_chunk(v[:, None], carry, cs)
-        return ncarry, out
+        zrows, totals = _transpose_cumsum(v[:, None])
+        return 0, (zrows, totals)
 
-    z1 = jnp.zeros((1,), jnp.float32)
-    carry, packed = jax.lax.scan(body, (z1, z1), (tail_sb, tail_lane))
-    return prefix_pair_extract(packed, None, carry, bnd_blk, bnd_off, 1)
+    _, (z, totals) = jax.lax.scan(body, 0, (tail_sb, tail_lane))
+    flatz = jnp.concatenate(
+        [z.reshape(-1, BLOCK), jnp.zeros((1, BLOCK), jnp.float32)]
+    )
+    gl = zstream_extract(flatz, 1, segs, bnd_row, bnd_grp)
+    y = gl[1:] - gl[:-1]
+    ph, pl = _dd_prefix(totals.reshape(-1, 1))
+    corr = (
+        (ph[xing_s1] - ph[xing_s0]) + (pl[xing_s1] - pl[xing_s0])
+    )
+    return y.at[xing_idx].add(corr.reshape(-1))
 
 
 def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
@@ -629,15 +656,19 @@ def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
     acc = acc[:nv]
 
     return acc + lane_select_tail_sums(
-        x2d, dh.tail_sb, dh.tail_lane,
-        dh.tail_bnd_blk, dh.tail_bnd_off, dh.tail_cs,
+        x2d, dh.tail_sb, dh.tail_lane, dh.tail_bnd_row, dh.tail_bnd_grp,
+        dh.tail_xing_idx, dh.tail_xing_s0, dh.tail_xing_s1, dh.tail_segs,
     )
 
 
 for _cls, _data, _meta in (
-    (DeviceLevel, ["strips", "cols", "bnd_blk", "bnd_off"], ["r", "cs"]),
+    (DeviceLevel,
+     ["strips", "cols", "bnd_row", "bnd_grp",
+      "xing_idx", "xing_s0", "xing_s1"],
+     ["r", "segs"]),
     (DeviceHybrid,
-     ["levels", "tail_sb", "tail_lane", "tail_bnd_blk", "tail_bnd_off"],
-     ["tail_cs", "nvb"]),
+     ["levels", "tail_sb", "tail_lane", "tail_bnd_row", "tail_bnd_grp",
+      "tail_xing_idx", "tail_xing_s0", "tail_xing_s1"],
+     ["tail_segs", "nvb"]),
 ):
     jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=_meta)

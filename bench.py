@@ -11,7 +11,10 @@ v5e chip, so we report vs_baseline against BASELINE_GTEPS / 8 (the per-GPU
 share), keeping the number honest for single-chip hardware.
 
 Knobs (env): LUX_BENCH_SCALE (default 22 → 4.19M vertices, 67.1M edges),
-LUX_BENCH_EF (16), LUX_BENCH_ITERS (20), LUX_BENCH_CACHE (.bench_cache).
+LUX_BENCH_EF (16), LUX_BENCH_ITERS (20), LUX_BENCH_CACHE (.bench_cache),
+LUX_BENCH_LAYOUT (tiled|flat), LUX_BENCH_LEVELS (e.g. "8/4" or
+"32/8,8/3,2/2"), LUX_BENCH_TILE_MB (strip budget). Hybrid plans are
+cached next to the graph (planning is minutes of host np.unique time).
 """
 
 from __future__ import annotations
@@ -66,14 +69,32 @@ def main():
         raise SystemExit(f"LUX_BENCH_LAYOUT must be 'tiled' or 'flat', got {layout!r}")
     if layout == "tiled":
         from lux_tpu.engine.tiled import TiledPullExecutor
+        from lux_tpu.ops.tiled_spmv import load_plan, plan_hybrid, save_plan
 
         budget = int(os.environ.get("LUX_BENCH_TILE_MB", "6144")) << 20
+        levels = tuple(
+            tuple(int(v) for v in part.split("/"))
+            for part in os.environ.get("LUX_BENCH_LEVELS", "8/4").split(",")
+        )
+        lev_tag = "_".join(f"{r}x{t}" for r, t in levels)
+        plan_path = os.path.join(
+            cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.npz"
+        )
         t0 = time.time()
-        ex = TiledPullExecutor(g, PageRank(), budget_bytes=budget)
+        if os.path.exists(plan_path):
+            plan = load_plan(plan_path)
+            print(f"# loaded cached plan {plan_path} in {time.time()-t0:.1f}s",
+                  file=sys.stderr)
+        else:
+            plan = plan_hybrid(g, levels=levels, budget_bytes=budget)
+            save_plan(plan_path, plan)
+            print(f"# planned {lev_tag} in {time.time()-t0:.1f}s",
+                  file=sys.stderr)
+        ex = TiledPullExecutor(g, PageRank(), plan=plan)
         print(
             f"# hybrid plan: {ex.plan.num_strips} strips "
             f"({ex.plan.strip_bytes/1e9:.2f} GB), "
-            f"coverage={ex.plan.coverage:.1%}, built in {time.time()-t0:.1f}s",
+            f"coverage={ex.plan.coverage:.1%}",
             file=sys.stderr,
         )
     else:

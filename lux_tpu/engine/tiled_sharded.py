@@ -49,6 +49,7 @@ from lux_tpu.ops.tiled_spmv import (
     GATHER_TABLE_BYTES,
     DeviceLevel,
     HybridPlan,
+    block_level_boundaries,
     crossing_correction,
     lane_select_tail_sums,
     plan_hybrid,
@@ -302,9 +303,7 @@ class ShardedTiledExecutor:
                     lev.rows[i0:i1], np.arange(nrb_global + 1, dtype=np.int64)
                 )
                 if lev.r == BLOCK:
-                    kk = b // c
-                    row[p] = (kk * (c + 1) + (b - kk * c)).astype(np.int32)
-                    grp[p] = kk.astype(np.int32)
+                    row[p], grp[p] = block_level_boundaries(b, c)
                     xi = s0 = s1 = np.zeros(0, np.int32)
                 else:
                     row[p], grp[p], sub = zstream_boundaries(b, c, lev.r)

@@ -26,18 +26,18 @@ Every edge is served by one of two such layouts:
    R/3 edges (R=8 → ≥3 edges).
    Per-destination reduction of strip contributions uses NO scatter:
    strips are sorted by destination strip-row, so each row's strips are
-   a contiguous range with *plan-time-constant* boundaries; chunk-rebased
-   prefix pairs plus a static boundary gather-diff (blocked row gathers,
-   :func:`boundary_gather_data`) replace the 8-wide scatter rows of
-   ``jax.ops.segment_sum`` that ran at scalar rate
-   (measured 117 ms -> ~10 ms on RMAT22).
+   a contiguous range with *plan-time-constant* boundaries; transposed
+   Z-stream cumsums plus a static boundary gather-diff (see the layout
+   notes above :func:`zstream_boundaries`) replace the 8-wide scatter
+   rows of ``jax.ops.segment_sum`` that ran at scalar rate
+   (measured 117 ms -> ~3 ms on RMAT22).
 
 2. **Lane-select tail**: a leftover edge costs one 128-wide row gather
    of its source block plus an on-the-fly one-hot lane selection
    (``where(lane == iota, row, 0).sum()``) — pure VPU, *exact* f32, and
    ~512 HBM bytes/edge instead of the 4.4 KB-equivalent of a scalar
    gather. Edges stay CSC-sorted so the per-destination reduction is
-   the scatter-free chunk-rebased prefix-pair diff at the static
+   the scatter-free Z-stream boundary diff at the static
    ``tail_row_ptr`` boundaries.
 
 This layout has no reference counterpart — it is what "gather" means on
@@ -223,6 +223,43 @@ def plan_hybrid(
     )
 
 
+def save_plan(path: str, plan: HybridPlan) -> None:
+    """Persist a plan to .npz (planning costs minutes of host np.unique
+    time at RMAT22+ scale; the plan is graph-deterministic)."""
+    data = dict(
+        nv=plan.nv, nvb=plan.nvb, order=plan.order, rank=plan.rank,
+        nlevels=len(plan.levels),
+        tail_sb=plan.tail_sb, tail_lane=plan.tail_lane,
+        tail_row_ptr=plan.tail_row_ptr,
+        out_degrees=plan.out_degrees, in_degrees=plan.in_degrees,
+    )
+    for i, lev in enumerate(plan.levels):
+        data[f"lev{i}_r"] = lev.r
+        data[f"lev{i}_strips"] = lev.strips
+        data[f"lev{i}_rows"] = lev.rows
+        data[f"lev{i}_cols"] = lev.cols
+    np.savez(path, **data)
+
+
+def load_plan(path: str) -> HybridPlan:
+    z = np.load(path)
+    levels = tuple(
+        StripLevel(
+            r=int(z[f"lev{i}_r"]),
+            strips=z[f"lev{i}_strips"],
+            rows=z[f"lev{i}_rows"],
+            cols=z[f"lev{i}_cols"],
+        )
+        for i in range(int(z["nlevels"]))
+    )
+    return HybridPlan(
+        nv=int(z["nv"]), nvb=int(z["nvb"]), order=z["order"], rank=z["rank"],
+        levels=levels, tail_sb=z["tail_sb"], tail_lane=z["tail_lane"],
+        tail_row_ptr=z["tail_row_ptr"],
+        out_degrees=z["out_degrees"], in_degrees=z["in_degrees"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Device side
 # ---------------------------------------------------------------------------
@@ -308,6 +345,17 @@ def zstream_boundaries(b: np.ndarray, chunk: int, r: int):
     return row.astype(np.int32), grp.astype(np.int32), b // cs
 
 
+def block_level_boundaries(b: np.ndarray, chunk: int):
+    """(row, chunk_index) for the r == 128 split two-gather form: local
+    rows are whole 128-lane blocks at flat row ``k*(chunk+1) + j``; P is
+    a small (K+1, 128) table row-gathered by chunk index."""
+    b = b.astype(np.int64)
+    k = b // chunk
+    row = k * (chunk + 1) + (b - k * chunk)
+    assert int(row.max(initial=0)) < 2**31
+    return row.astype(np.int32), k.astype(np.int32)
+
+
 def crossing_correction(sub: np.ndarray, r: int):
     """Static data for the sparse P-correction scatter.
 
@@ -361,12 +409,9 @@ def strip_boundaries(rows: np.ndarray, nchunks: int, chunk: int, nrb: int,
     """
     b = np.searchsorted(rows, np.arange(nrb + 1, dtype=np.int64))
     if r == BLOCK:
-        # Split two-gather form: rows are whole blocks, P is a small
-        # per-chunk table indexed by b//chunk.
-        k = b // chunk
-        row = (k * (chunk + 1) + (b - k * chunk)).astype(np.int32)
+        row, grp = block_level_boundaries(b, chunk)
         e = np.zeros(0, np.int32)
-        return row, k.astype(np.int32), e, e, e, ()
+        return row, grp, e, e, e, ()
     row, grp, sub = zstream_boundaries(b, chunk, r)
     xi, s0, s1 = crossing_correction(sub, r)
     return row, grp, xi, s0, s1, split_segments(b, nchunks, chunk, r)

@@ -79,7 +79,9 @@ def test_plan_respects_budget_and_density_floor():
 
 
 @pytest.mark.parametrize(
-    "levels", [((8, 1),), ((8, 4),), ((128, 8), (8, 2))]
+    "levels",
+    [((8, 1),), ((8, 4),), ((128, 8), (8, 2)),
+     ((2, 2),), ((16, 2),), ((64, 2),), ((32, 4), (4, 2))],
 )
 def test_hybrid_pagerank_parity_rmat(levels):
     g = generate.rmat(10, 8, seed=1)
@@ -117,6 +119,25 @@ def test_hybrid_all_tail_matches_plain_executor():
     a = np.asarray(tex.run(3))
     b = np.asarray(pex.run(3))
     np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-9)
+
+
+def test_plan_save_load_roundtrip(tmp_path):
+    from lux_tpu.ops.tiled_spmv import load_plan, save_plan
+
+    g = generate.rmat(9, 8, seed=3)
+    plan = plan_hybrid(g, levels=((128, 4), (8, 2)))
+    path = str(tmp_path / "plan.npz")
+    save_plan(path, plan)
+    back = load_plan(path)
+    assert back.nv == plan.nv and back.nvb == plan.nvb
+    assert plan_edge_multiset(back) == plan_edge_multiset(plan)
+    np.testing.assert_array_equal(back.order, plan.order)
+    np.testing.assert_array_equal(back.tail_row_ptr, plan.tail_row_ptr)
+    ex = TiledPullExecutor(g, PageRank(), plan=back, chunk_strips=16,
+                           chunk_tail=64)
+    got = np.asarray(ex.run(5))
+    want = reference_pagerank(g, 5)
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 
 def test_hybrid_run_resumes_from_external_vals():

@@ -71,21 +71,31 @@ def main():
         from lux_tpu.engine.tiled import TiledPullExecutor
         from lux_tpu.ops.tiled_spmv import load_plan, plan_hybrid, save_plan
 
-        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "6144")) << 20
+        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "8192")) << 20
         levels = tuple(
             tuple(int(v) for v in part.split("/"))
-            for part in os.environ.get("LUX_BENCH_LEVELS", "8/4").split(",")
+            for part in os.environ.get("LUX_BENCH_LEVELS", "8/2").split(",")
         )
         lev_tag = "_".join(f"{r}x{t}" for r, t in levels)
         plan_path = os.path.join(
             cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.npz"
         )
         t0 = time.time()
+        plan = None
         if os.path.exists(plan_path):
             plan = load_plan(plan_path)
-            print(f"# loaded cached plan {plan_path} in {time.time()-t0:.1f}s",
-                  file=sys.stderr)
-        else:
+            # Guard against a stale cache (regenerated graph, same name):
+            # the plan must partition exactly this graph's edges.
+            total = plan.tail_sb.shape[0] + sum(l.edges for l in plan.levels)
+            if plan.nv != g.nv or total != g.ne:
+                print(f"# cached plan {plan_path} does not match graph "
+                      f"(nv {plan.nv} vs {g.nv}, edges {total} vs {g.ne}) "
+                      f"— replanning", file=sys.stderr)
+                plan = None
+            else:
+                print(f"# loaded cached plan {plan_path} in "
+                      f"{time.time()-t0:.1f}s", file=sys.stderr)
+        if plan is None:
             plan = plan_hybrid(g, levels=levels, budget_bytes=budget)
             save_plan(plan_path, plan)
             print(f"# planned {lev_tag} in {time.time()-t0:.1f}s",

@@ -29,6 +29,7 @@ from lux_tpu.engine.pull import (
 )
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.tiled_spmv import (
+    DEFAULT_CHUNK_TAIL,
     DeviceHybrid,
     HybridPlan,
     hybrid_spmv,
@@ -60,7 +61,7 @@ class TiledPullExecutor:
         levels: Sequence[Tuple[int, int]] = ((8, 4),),
         budget_bytes: int = 6 << 30,
         chunk_strips: int = 16384,
-        chunk_tail: int = 1 << 19,
+        chunk_tail: int = DEFAULT_CHUNK_TAIL,
         plan: Optional[HybridPlan] = None,
         device=None,
     ):

@@ -46,6 +46,7 @@ from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.tiled_spmv import (
     BLOCK,
+    DEFAULT_CHUNK_TAIL,
     GATHER_TABLE_BYTES,
     DeviceLevel,
     HybridPlan,
@@ -227,7 +228,7 @@ class ShardedTiledExecutor:
         levels: Sequence[Tuple[int, int]] = ((8, 4),),
         budget_bytes: int = 6 << 30,
         chunk_strips: int = 16384,
-        chunk_tail: int = 1 << 19,
+        chunk_tail: int = DEFAULT_CHUNK_TAIL,
         plan: Optional[HybridPlan] = None,
     ):
         require_spmv_program(

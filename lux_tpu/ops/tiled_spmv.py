@@ -57,6 +57,10 @@ import numpy as np
 from lux_tpu.graph.graph import Graph
 
 BLOCK = 128
+# Scan-chunk default for the tail body: measured sweet spot on v5e
+# (PERF.md chunk sweep — ~10% faster than 2^19; smaller chunks pipeline
+# the gathers better).
+DEFAULT_CHUNK_TAIL = 1 << 17
 
 
 # ---------------------------------------------------------------------------
@@ -456,7 +460,7 @@ class DeviceHybrid:
     def build(
         plan: HybridPlan,
         chunk_strips: int = 16384,
-        chunk_tail: int = 1 << 19,
+        chunk_tail: int = DEFAULT_CHUNK_TAIL,
         device=None,
     ) -> "DeviceHybrid":
         put = lambda x: jax.device_put(jnp.asarray(x), device)

@@ -11,7 +11,7 @@ v5e chip, so we report vs_baseline against BASELINE_GTEPS / 8 (the per-GPU
 share), keeping the number honest for single-chip hardware.
 
 Knobs (env): LUX_BENCH_SCALE (default 22 → 4.19M vertices, 67.1M edges),
-LUX_BENCH_EF (16), LUX_BENCH_ITERS (20), LUX_BENCH_CACHE (.bench_cache),
+LUX_BENCH_EF (16), LUX_BENCH_ITERS (50), LUX_BENCH_CACHE (.bench_cache),
 LUX_BENCH_LAYOUT (tiled|flat), LUX_BENCH_LEVELS (e.g. "8/4" or
 "32/8,8/3,2/2"), LUX_BENCH_TILE_MB (strip budget). Hybrid plans are
 cached next to the graph (planning is minutes of host np.unique time).
@@ -50,7 +50,7 @@ def get_graph(scale: int, ef: int, cache_dir: str):
 def main():
     scale = int(os.environ.get("LUX_BENCH_SCALE", "22"))
     ef = int(os.environ.get("LUX_BENCH_EF", "16"))
-    iters = int(os.environ.get("LUX_BENCH_ITERS", "20"))
+    iters = int(os.environ.get("LUX_BENCH_ITERS", "50"))
     cache = os.environ.get("LUX_BENCH_CACHE",
                            os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                         ".bench_cache"))
@@ -83,16 +83,22 @@ def main():
         t0 = time.time()
         plan = None
         if os.path.exists(plan_path):
-            plan = load_plan(plan_path)
-            # Guard against a stale cache (regenerated graph, same name):
-            # the plan must partition exactly this graph's edges.
-            total = plan.tail_sb.shape[0] + sum(l.edges for l in plan.levels)
-            if plan.nv != g.nv or total != g.ne:
-                print(f"# cached plan {plan_path} does not match graph "
-                      f"(nv {plan.nv} vs {g.nv}, edges {total} vs {g.ne}) "
+            # Guard against a stale or corrupt cache (regenerated graph
+            # under the same name, or an interrupted save): the plan must
+            # load cleanly and partition exactly this graph's edges.
+            try:
+                plan = load_plan(plan_path)
+            except Exception as e:
+                print(f"# cached plan {plan_path} unreadable ({e!r}) "
                       f"— replanning", file=sys.stderr)
+            if plan is not None and (
+                plan.nv != g.nv or plan.total_edges != g.ne
+            ):
+                print(f"# cached plan {plan_path} does not match graph "
+                      f"(nv {plan.nv} vs {g.nv}, edges {plan.total_edges} "
+                      f"vs {g.ne}) — replanning", file=sys.stderr)
                 plan = None
-            else:
+            elif plan is not None:
                 print(f"# loaded cached plan {plan_path} in "
                       f"{time.time()-t0:.1f}s", file=sys.stderr)
         if plan is None:

@@ -58,8 +58,8 @@ class TiledPullExecutor:
         self,
         graph: Graph,
         program: PullProgram,
-        levels: Sequence[Tuple[int, int]] = ((8, 4),),
-        budget_bytes: int = 6 << 30,
+        levels: Sequence[Tuple[int, int]] = ((8, 2),),
+        budget_bytes: int = 8 << 30,
         chunk_strips: int = 16384,
         chunk_tail: int = DEFAULT_CHUNK_TAIL,
         plan: Optional[HybridPlan] = None,

@@ -83,7 +83,7 @@ class StripLevel:
 
     @property
     def edges(self) -> int:
-        return int(self.strips.astype(np.int64).sum())
+        return int(self.strips.sum(dtype=np.int64))
 
 
 @dataclasses.dataclass(eq=False)
@@ -115,9 +115,12 @@ class HybridPlan:
         return sum(lev.nbytes for lev in self.levels)
 
     @property
+    def total_edges(self) -> int:
+        return self.tail_sb.shape[0] + sum(lev.edges for lev in self.levels)
+
+    @property
     def coverage(self) -> float:
-        total = self.tail_sb.shape[0] + sum(lev.edges for lev in self.levels)
-        return 1.0 - self.tail_sb.shape[0] / max(total, 1)
+        return 1.0 - self.tail_sb.shape[0] / max(self.total_edges, 1)
 
 
 def _relabel(graph: Graph, reorder: str):
@@ -136,8 +139,8 @@ def _relabel(graph: Graph, reorder: str):
 
 def plan_hybrid(
     graph: Graph,
-    levels: Sequence[Tuple[int, int]] = ((8, 4),),
-    budget_bytes: int = 6 << 30,
+    levels: Sequence[Tuple[int, int]] = ((8, 2),),
+    budget_bytes: int = 8 << 30,
     reorder: str = "degree",
 ) -> HybridPlan:
     """Partition edges into strip levels + a lane-select tail. Exact.

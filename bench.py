@@ -91,11 +91,10 @@ def main():
             except Exception as e:
                 print(f"# cached plan {plan_path} unreadable ({e!r}) "
                       f"— replanning", file=sys.stderr)
-            if plan is not None and (
-                plan.nv != g.nv or plan.total_edges != g.ne
-            ):
+            total = plan.total_edges if plan is not None else 0
+            if plan is not None and (plan.nv != g.nv or total != g.ne):
                 print(f"# cached plan {plan_path} does not match graph "
-                      f"(nv {plan.nv} vs {g.nv}, edges {plan.total_edges} "
+                      f"(nv {plan.nv} vs {g.nv}, edges {total} "
                       f"vs {g.ne}) — replanning", file=sys.stderr)
                 plan = None
             elif plan is not None:

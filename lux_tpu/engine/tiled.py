@@ -29,6 +29,7 @@ from lux_tpu.engine.pull import (
 )
 from lux_tpu.graph.graph import Graph
 from lux_tpu.ops.tiled_spmv import (
+    DEFAULT_CHUNK_STRIPS,
     DEFAULT_CHUNK_TAIL,
     DeviceHybrid,
     HybridPlan,
@@ -60,7 +61,7 @@ class TiledPullExecutor:
         program: PullProgram,
         levels: Sequence[Tuple[int, int]] = ((8, 2),),
         budget_bytes: int = 8 << 30,
-        chunk_strips: int = 16384,
+        chunk_strips: int = DEFAULT_CHUNK_STRIPS,
         chunk_tail: int = DEFAULT_CHUNK_TAIL,
         plan: Optional[HybridPlan] = None,
         device=None,

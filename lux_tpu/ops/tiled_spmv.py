@@ -576,6 +576,9 @@ def zstream_extract(
         if nbs == 0:
             continue
         sub_tbl = jax.lax.slice(flatz, (base, 0), (base + cnt, BLOCK))
+        # NOTE: an isolated (8,4)-plan sweep suggested 2^16 here, but
+        # end-to-end with the default (8,2) plan it regressed 115 ->
+        # 127 ms/iter; 2^19 is the measured end-to-end best.
         cb = min(1 << 19, nbs)
         pad = (-nbs) % cb
         idx = jnp.pad(bnd_row[lo:hi] - base, (0, pad)).reshape(-1, cb)

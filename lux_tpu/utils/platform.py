@@ -7,6 +7,33 @@ its registration can fail (plugin import error, device held elsewhere).
 
 from __future__ import annotations
 
+import re
+
+
+def virtual_cpu_flags(n_devices: int, xla_flags: str = None) -> str:
+    """Return ``xla_flags`` with ``--xla_force_host_platform_device_count``
+    guaranteed to be >= ``n_devices`` (existing larger values are kept;
+    smaller ones are replaced). Pass the result as the subprocess/env
+    XLA_FLAGS, then force ``jax_platforms=cpu`` via jax.config BEFORE any
+    backend initializes (env JAX_PLATFORMS alone is overridden by
+    sitecustomize-registered plugins)."""
+    import os
+
+    if xla_flags is None:
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+    pat = r"--xla_force_host_platform_device_count=(\d+)"
+    m = re.search(pat, xla_flags)
+    if m:
+        if int(m.group(1)) >= n_devices:
+            return xla_flags
+        return re.sub(
+            pat, f"--xla_force_host_platform_device_count={n_devices}",
+            xla_flags,
+        )
+    return (
+        xla_flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+
 
 def ensure_backend() -> str:
     """Return the platform actually in use, falling back to CPU if the

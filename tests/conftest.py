@@ -12,14 +12,12 @@ as no backend has been initialized yet.
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lux_tpu.utils.platform import virtual_cpu_flags  # noqa: E402
+
+os.environ["XLA_FLAGS"] = virtual_cpu_flags(8)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -25,7 +25,22 @@ from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
 
 
 def _edge_index_dtype(ne: int):
-    return jnp.int32 if ne < 2**31 else jnp.int64
+    """Device dtype for edge offsets (row_ptr): int32 below 2^31 edges,
+    int64 at the reference's E_ID=uint64 headroom (README.md:79-86).
+
+    int64 on device requires ``jax_enable_x64``; without it JAX silently
+    downcasts to int32, which would overflow — fail loudly instead."""
+    if ne < 2**31:
+        return jnp.int32
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"graph has {ne} >= 2^31 edges: edge offsets need int64 on "
+            "device; enable it with jax.config.update('jax_enable_x64', "
+            "True) (or JAX_ENABLE_X64=1) before building the executor"
+        )
+    return jnp.int64
 
 
 def hard_sync(x):

@@ -51,6 +51,7 @@ from lux_tpu.ops.tiled_spmv import (
     GATHER_TABLE_BYTES,
     DeviceLevel,
     HybridPlan,
+    _warn_big_table as _warn_big_table_impl,
     block_level_boundaries,
     crossing_correction,
     lane_select_tail_sums,
@@ -192,21 +193,10 @@ def _pad_stack(arrs, width: int, dtype=np.int32) -> np.ndarray:
 
 
 def _warn_big_table(nrows: int, what: str):
-    """Per-shard Z-streams are single unsegmented gather tables (the
-    segment splits of the single-device path are per-part data, which
-    shard_map's one-trace-for-all-shards model can't make static); warn
-    when that table crosses the measured big-gather cliff — only small
-    part counts (P <= 2) on huge graphs get here."""
-    if nrows * BLOCK * 4 > GATHER_TABLE_BYTES:
-        import warnings
-
-        warnings.warn(
-            f"sharded {what}: per-shard boundary-extraction table is "
-            f"{nrows * BLOCK * 4 >> 20} MB, above the ~{GATHER_TABLE_BYTES >> 20} MB "
-            f"gather cliff — extraction will run ~4x off-rate; use more "
-            f"parts or the single-device executor",
-            stacklevel=3,
-        )
+    """Sharded wrapper: per-shard Z-streams are single unsegmented gather
+    tables (see ops.tiled_spmv._warn_big_table) — only small part counts
+    (P <= 2) on huge graphs trip this."""
+    _warn_big_table_impl(nrows, f"sharded {what} (per-shard)")
 
 
 class ShardedTiledExecutor:

@@ -43,6 +43,7 @@ class Graph:
     weights: Optional[np.ndarray] = None    # int32 (ne,) or None
     _out_degrees: Optional[np.ndarray] = None  # lazily computed
     _csr: Optional["Csr"] = None               # lazily built out-edge view
+    _col_dst: Optional[np.ndarray] = None      # lazily expanded CSC dsts
 
     def __post_init__(self):
         self.nv = int(self.nv)
@@ -75,10 +76,16 @@ class Graph:
 
     @property
     def col_dst(self) -> np.ndarray:
-        """Destination vertex per in-edge (expansion of the CSC segments)."""
-        return np.repeat(
-            np.arange(self.nv, dtype=np.int32), self.in_degrees
-        )
+        """Destination vertex per in-edge (expansion of the CSC segments).
+
+        Cached: executor builds hit this several times, and at RMAT27
+        scale each np.repeat is a multi-GB host materialization.
+        """
+        if self._col_dst is None:
+            self._col_dst = np.repeat(
+                np.arange(self.nv, dtype=np.int32), self.in_degrees
+            )
+        return self._col_dst
 
     def csr(self) -> "Csr":
         """Out-edge (push) view: edges grouped by source.

@@ -85,6 +85,12 @@ def take1d_blocked(z: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     — ~1.5 KB of streamed traffic per element instead of a ~4.4 KB-equiv
     scalarized access. Exact (pure selection). Chunked with a scan so the
     (len(idx), 128) gather/select intermediates stay bounded.
+
+    Caveat: the gather table ``zz`` is the FULL (padded) ``z`` — tables
+    past the ~48 MB gather cliff (ops.tiled_spmv.GATHER_TABLE_BYTES, e.g.
+    the RMAT22 flat-path cumsum at ~268 MB) run row gathers ~4x
+    off-rate. Still far faster than scalar gathers; the tiled executor's
+    zstream_extract segments its tables and is the fast path at scale.
     """
     n = idx.shape[0]
     if n == 0:

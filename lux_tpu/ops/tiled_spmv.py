@@ -253,22 +253,23 @@ def save_plan(path: str, plan: HybridPlan) -> None:
 
 
 def load_plan(path: str) -> HybridPlan:
-    z = np.load(path)
-    levels = tuple(
-        StripLevel(
-            r=int(z[f"lev{i}_r"]),
-            strips=z[f"lev{i}_strips"],
-            rows=z[f"lev{i}_rows"],
-            cols=z[f"lev{i}_cols"],
+    with np.load(path) as z:
+        levels = tuple(
+            StripLevel(
+                r=int(z[f"lev{i}_r"]),
+                strips=z[f"lev{i}_strips"],
+                rows=z[f"lev{i}_rows"],
+                cols=z[f"lev{i}_cols"],
+            )
+            for i in range(int(z["nlevels"]))
         )
-        for i in range(int(z["nlevels"]))
-    )
-    return HybridPlan(
-        nv=int(z["nv"]), nvb=int(z["nvb"]), order=z["order"], rank=z["rank"],
-        levels=levels, tail_sb=z["tail_sb"], tail_lane=z["tail_lane"],
-        tail_row_ptr=z["tail_row_ptr"],
-        out_degrees=z["out_degrees"], in_degrees=z["in_degrees"],
-    )
+        return HybridPlan(
+            nv=int(z["nv"]), nvb=int(z["nvb"]),
+            order=z["order"], rank=z["rank"],
+            levels=levels, tail_sb=z["tail_sb"], tail_lane=z["tail_lane"],
+            tail_row_ptr=z["tail_row_ptr"],
+            out_degrees=z["out_degrees"], in_degrees=z["in_degrees"],
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +299,25 @@ def _dd_add(a, b):
 # cliff between 64 MB and 139 MB operands; an in-jit lax.slice restores
 # the fast rate), so extraction tables are split into segments below it.
 GATHER_TABLE_BYTES = 48 << 20
+
+
+def _warn_big_table(nrows: int, what: str):
+    """Warn when an unsegmented boundary-extraction gather table crosses
+    the measured big-gather cliff (extraction runs ~4x off-rate above it).
+    Used by paths whose tables cannot be (or are not yet) segmented: the
+    sharded Z-streams (segment splits are per-part data, which
+    shard_map's one-trace-for-all-shards model can't make static) and the
+    single-device r==128 hub levels (normally tiny)."""
+    if nrows * BLOCK * 4 > GATHER_TABLE_BYTES:
+        import warnings
+
+        warnings.warn(
+            f"{what}: boundary-extraction table is "
+            f"{nrows * BLOCK * 4 >> 20} MB, above the "
+            f"~{GATHER_TABLE_BYTES >> 20} MB gather cliff — extraction "
+            f"will run ~4x off-rate",
+            stacklevel=3,
+        )
 
 
 def _subs_per_chunk(r: int) -> int:
@@ -622,6 +642,13 @@ def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarra
         # Split two-gather form: a (C+1, 128) local-cumsum block per
         # chunk + a small (K+1, 128) chunk-prefix table (chunk-level
         # rebase only — r=128 levels are small hub tiles).
+        # Accuracy note: the chunk-prefix chain here is plain f32 (no
+        # double-single compensation), so boundary diffs for hub rows
+        # carry eps * level-stream-mass cancellation error — weaker than
+        # the r<128 levels' sub-chunk-mass bound. Fine for the small hub
+        # levels this branch serves (tests pass at 5e-5 rtol); switch to
+        # _dd_prefix on the chunk totals if large r=128 levels become a
+        # supported config.
         def body(carry, chunk):
             s_loc = jnp.cumsum(contrib_of(chunk), axis=0)
             out = jnp.concatenate(
@@ -636,6 +663,7 @@ def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarra
             [z.reshape(-1, BLOCK), jnp.zeros((1, BLOCK), jnp.float32)]
         )
         pp = jnp.concatenate([pk, carry[None]])          # (K+1, 128)
+        _warn_big_table(lf.shape[0], f"strip level r={BLOCK}")
         gl = lf[lev.bnd_row].reshape(-1)
         gp = pp[lev.bnd_grp].reshape(-1)
         return (gp[r:] - gp[:-r]) + (gl[r:] - gl[:-r])

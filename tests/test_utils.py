@@ -71,3 +71,53 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert out.shape == args[0].shape
     ge.dryrun_multichip(4)
+
+
+def test_edge_index_dtype_2_31_boundary():
+    """At ne = 2^31 the reference's E_ID=uint64 headroom (README.md:79-86)
+    must kick in: int32 row offsets would overflow. Without x64 enabled
+    JAX would silently downcast int64 → int32, so the dtype helper must
+    refuse rather than overflow."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from lux_tpu.engine.pull import _edge_index_dtype
+
+    assert _edge_index_dtype(2**31 - 1) == jnp.int32
+    if jax.config.jax_enable_x64:
+        assert _edge_index_dtype(2**31) == jnp.int64
+    else:
+        with pytest.raises(ValueError, match="2\\^31"):
+            _edge_index_dtype(2**31)
+
+
+def test_virtual_cpu_flags():
+    from lux_tpu.utils.platform import virtual_cpu_flags
+
+    assert (
+        virtual_cpu_flags(8, "")
+        == "--xla_force_host_platform_device_count=8"
+    )
+    assert (
+        virtual_cpu_flags(8, "--xla_force_host_platform_device_count=2")
+        == "--xla_force_host_platform_device_count=8"
+    )
+    kept = "--xla_force_host_platform_device_count=16"
+    assert virtual_cpu_flags(8, kept) == kept
+    assert (
+        virtual_cpu_flags(4, "--a --xla_force_host_platform_device_count=2 --b")
+        == "--a --xla_force_host_platform_device_count=4 --b"
+    )
+
+
+def test_col_dst_cached():
+    import numpy as np
+
+    from lux_tpu.graph import generate
+
+    g = generate.rmat(6, 4, seed=0)
+    a = g.col_dst
+    assert g.col_dst is a  # cached, not recomputed
+    want = np.repeat(np.arange(g.nv), np.diff(g.row_ptr))
+    np.testing.assert_array_equal(a, want)

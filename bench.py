@@ -68,8 +68,7 @@ def main():
     if layout not in ("tiled", "flat"):
         raise SystemExit(f"LUX_BENCH_LAYOUT must be 'tiled' or 'flat', got {layout!r}")
     if layout == "tiled":
-        from lux_tpu.engine.tiled import TiledPullExecutor
-        from lux_tpu.ops.tiled_spmv import load_plan, plan_hybrid, save_plan
+        from lux_tpu.engine.tiled import TiledPullExecutor, get_cached_plan
 
         budget = int(os.environ.get("LUX_BENCH_TILE_MB", "8192")) << 20
         levels = tuple(
@@ -81,30 +80,12 @@ def main():
             cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.npz"
         )
         t0 = time.time()
-        plan = None
-        if os.path.exists(plan_path):
-            # Guard against a stale or corrupt cache (regenerated graph
-            # under the same name, or an interrupted save): the plan must
-            # load cleanly and partition exactly this graph's edges.
-            try:
-                plan = load_plan(plan_path)
-            except Exception as e:
-                print(f"# cached plan {plan_path} unreadable ({e!r}) "
-                      f"— replanning", file=sys.stderr)
-            total = plan.total_edges if plan is not None else 0
-            if plan is not None and (plan.nv != g.nv or total != g.ne):
-                print(f"# cached plan {plan_path} does not match graph "
-                      f"(nv {plan.nv} vs {g.nv}, edges {total} "
-                      f"vs {g.ne}) — replanning", file=sys.stderr)
-                plan = None
-            elif plan is not None:
-                print(f"# loaded cached plan {plan_path} in "
-                      f"{time.time()-t0:.1f}s", file=sys.stderr)
-        if plan is None:
-            plan = plan_hybrid(g, levels=levels, budget_bytes=budget)
-            save_plan(plan_path, plan)
-            print(f"# planned {lev_tag} in {time.time()-t0:.1f}s",
-                  file=sys.stderr)
+        plan = get_cached_plan(
+            g, plan_path, levels=levels, budget_bytes=budget,
+            log=lambda m: print(f"# {m}", file=sys.stderr),
+        )
+        print(f"# plan ready ({lev_tag}) in {time.time()-t0:.1f}s",
+              file=sys.stderr)
         ex = TiledPullExecutor(g, PageRank(), plan=plan)
         print(
             f"# hybrid plan: {ex.plan.num_strips} strips "

@@ -38,6 +38,70 @@ from lux_tpu.ops.tiled_spmv import (
 )
 
 
+def spmv_capable(program: PullProgram) -> bool:
+    """True if the strip/lane-select hybrid can run this program
+    (sum combiner, edge contribution == source value)."""
+    return (
+        program.combiner == "sum"
+        and getattr(program, "identity_contrib", False)
+        and not getattr(program, "value_shape", ())  # scalar values only
+    )
+
+
+def get_cached_plan(
+    graph: Graph,
+    path: str,
+    levels: Sequence[Tuple[int, int]] = ((8, 2),),
+    budget_bytes: int = 8 << 30,
+    log=None,
+) -> HybridPlan:
+    """Load the hybrid plan cached at ``path`` (validating it against the
+    graph), else plan and save. Planning costs minutes of host time at
+    RMAT22+ scale and is graph-deterministic, so every entry point (CLI,
+    bench) should come through here. A failed save (read-only graph dir)
+    degrades to planning without a cache."""
+    import os
+
+    from lux_tpu.ops.tiled_spmv import load_plan, save_plan
+
+    say = log if log is not None else (lambda *_: None)
+    if os.path.exists(path):
+        plan = None
+        try:
+            plan = load_plan(path)
+        except Exception as e:
+            say(f"cached plan {path} unreadable ({e!r}) — replanning")
+        if plan is not None and (
+            plan.nv != graph.nv or plan.total_edges != graph.ne
+        ):
+            say(
+                f"cached plan {path} does not match graph "
+                f"(nv {plan.nv} vs {graph.nv}, edges {plan.total_edges} "
+                f"vs {graph.ne}) — replanning"
+            )
+            plan = None
+        # Config check: the cascade's r-sequence is recoverable from the
+        # plan; thresholds/budget are not stored, so a same-r cascade with
+        # a different thr/budget would still be served (callers that key
+        # the path by config, like the CLI default, never hit this).
+        want_rs = tuple(r for r, _ in levels)
+        if plan is not None and tuple(l.r for l in plan.levels) != want_rs:
+            say(
+                f"cached plan {path} has cascade r-levels "
+                f"{tuple(l.r for l in plan.levels)}, requested {want_rs} "
+                "— replanning"
+            )
+            plan = None
+        if plan is not None:
+            return plan
+    plan = plan_hybrid(graph, levels=levels, budget_bytes=budget_bytes)
+    try:
+        save_plan(path, plan)
+    except OSError as e:
+        say(f"could not cache plan at {path}: {e}")
+    return plan
+
+
 def require_spmv_program(program: PullProgram, cls: str, fallback: str):
     """Tiled executors only run sum-combiner programs whose edge
     contribution is the source value (SpMV shape)."""

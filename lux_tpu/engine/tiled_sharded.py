@@ -196,7 +196,10 @@ def _warn_big_table(nrows: int, what: str):
     """Sharded wrapper: per-shard Z-streams are single unsegmented gather
     tables (see ops.tiled_spmv._warn_big_table) — only small part counts
     (P <= 2) on huge graphs trip this."""
-    _warn_big_table_impl(nrows, f"sharded {what} (per-shard)")
+    _warn_big_table_impl(
+        nrows, f"sharded {what} (per-shard)",
+        advice="; use more parts or the single-device executor",
+    )
 
 
 class ShardedTiledExecutor:

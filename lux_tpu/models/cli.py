@@ -44,7 +44,25 @@ def build_parser(name: str, push: bool) -> argparse.ArgumentParser:
     )
     p.add_argument(
         "-strategy", choices=["rowptr", "segment"], default="rowptr",
-        help="sum-combiner reduction strategy (pull apps)",
+        help="sum-combiner reduction strategy (flat pull apps)",
+    )
+    p.add_argument(
+        "-layout", choices=["auto", "flat", "tiled"], default="auto",
+        help="pull engine: 'tiled' = strip/lane-select hybrid (the fast "
+        "path for SpMV-shaped programs like PageRank), 'flat' = plain "
+        "gather engine, 'auto' = tiled when the program supports it",
+    )
+    p.add_argument(
+        "-levels", default="8/2",
+        help="tiled layout strip cascade, e.g. '8/2' or '32/8,8/3,2/2'",
+    )
+    p.add_argument(
+        "-tile-mb", type=int, default=8192, dest="tile_mb",
+        help="tiled layout strip memory budget (MB)",
+    )
+    p.add_argument(
+        "-plan-cache", dest="plan_cache",
+        help="hybrid plan cache path (default: next to the graph file)",
     )
     p.add_argument("-save", help="write checkpoint npz after the run")
     p.add_argument("-resume", help="resume vertex state from checkpoint npz")
@@ -79,23 +97,106 @@ def memory_advisory(g, parts: int, value_bytes: int, push: bool):
     )
 
 
-def make_executor(g, program, args):
+def _parse_levels(spec: str):
+    try:
+        levels = tuple(
+            tuple(int(v) for v in part.split("/"))
+            for part in spec.split(",")
+        )
+        if not all(len(lv) == 2 for lv in levels):
+            raise ValueError
+        return levels
+    except ValueError:
+        raise SystemExit(
+            f"error: -levels {spec!r} is malformed; expected "
+            "'r/thr[,r/thr...]', e.g. '8/2' or '32/8,8/3,2/2'"
+        )
+
+
+def _tiled_plan(g, program, args, log):
+    """Resolve the hybrid plan for a tiled run (cached next to the graph
+    file, keyed by cascade + budget so different configs coexist)."""
+    from lux_tpu.engine.tiled import get_cached_plan
+
+    levels = _parse_levels(args.levels)
+    budget = args.tile_mb << 20
+    path = args.plan_cache or (
+        args.file
+        + ".plan_"
+        + "_".join(f"{r}x{t}" for r, t in levels)
+        + f"_{args.tile_mb}.npz"
+    )
+    with Timer() as t:
+        plan = get_cached_plan(
+            g, path, levels=levels, budget_bytes=budget, log=log.info
+        )
+    log.info(
+        "hybrid plan: %d strips (%.2f GB), coverage=%.1f%% (%.1fs)",
+        plan.num_strips, plan.strip_bytes / 1e9, plan.coverage * 100,
+        t.elapsed,
+    )
+    return plan
+
+
+def make_executor(g, program, args, log=None):
+    """Pick the engine. Pull programs default to the tiled (strip/
+    lane-select hybrid) executor when the program is SpMV-shaped — the
+    reference likewise has exactly one entry point per app
+    (pagerank.cc:32-119) with the fast kernel behind it; ``-layout flat``
+    forces the plain gather engine."""
+    if log is None:
+        log = get_logger(program.name)
+    is_push = hasattr(program, "init_frontier")
+    use_tiled = False
+    if is_push and args.layout != "auto":
+        raise SystemExit(
+            f"error: -layout {args.layout} has no effect on "
+            f"{program.name} (a push-model app); drop the flag"
+        )
+    if not is_push:
+        from lux_tpu.engine.tiled import spmv_capable
+
+        if args.layout == "tiled":
+            if not spmv_capable(program):
+                raise SystemExit(
+                    f"-layout tiled: {program.name} is not SpMV-shaped "
+                    "(needs sum combiner + identity contribution)"
+                )
+            use_tiled = True
+        elif args.layout == "auto":
+            use_tiled = spmv_capable(program)
+
     if args.parts > 1:
-        from lux_tpu.engine.push import ShardedPushExecutor
-        from lux_tpu.engine.pull_sharded import ShardedPullExecutor
         from lux_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.parts)
-        if hasattr(program, "init_frontier"):
+        if is_push:
+            from lux_tpu.engine.push import ShardedPushExecutor
+
             return ShardedPushExecutor(g, program, mesh=mesh)
+        if use_tiled:
+            from lux_tpu.engine.tiled_sharded import ShardedTiledExecutor
+
+            return ShardedTiledExecutor(
+                g, program, mesh=mesh, plan=_tiled_plan(g, program, args, log)
+            )
+        from lux_tpu.engine.pull_sharded import ShardedPullExecutor
+
         return ShardedPullExecutor(
             g, program, mesh=mesh, sum_strategy=args.strategy
         )
-    from lux_tpu.engine.pull import PullExecutor
-    from lux_tpu.engine.push import PushExecutor
+    if is_push:
+        from lux_tpu.engine.push import PushExecutor
 
-    if hasattr(program, "init_frontier"):
         return PushExecutor(g, program)
+    if use_tiled:
+        from lux_tpu.engine.tiled import TiledPullExecutor
+
+        return TiledPullExecutor(
+            g, program, plan=_tiled_plan(g, program, args, log)
+        )
+    from lux_tpu.engine.pull import PullExecutor
+
     return PullExecutor(g, program, sum_strategy=args.strategy)
 
 
@@ -225,6 +326,10 @@ def _host_to_device(ex, host_vals):
     import jax
     import jax.numpy as jnp
 
+    if hasattr(ex, "_to_padded_internal"):
+        # Sharded tiled executor: its device layout is the padded
+        # degree-sorted shard stack; it owns the converter.
+        return ex._to_padded_internal(host_vals)
     if hasattr(ex, "sg"):
         from lux_tpu.parallel.mesh import parts_sharding
 

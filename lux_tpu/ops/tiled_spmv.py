@@ -301,13 +301,14 @@ def _dd_add(a, b):
 GATHER_TABLE_BYTES = 48 << 20
 
 
-def _warn_big_table(nrows: int, what: str):
+def _warn_big_table(nrows: int, what: str, advice: str = ""):
     """Warn when an unsegmented boundary-extraction gather table crosses
     the measured big-gather cliff (extraction runs ~4x off-rate above it).
     Used by paths whose tables cannot be (or are not yet) segmented: the
     sharded Z-streams (segment splits are per-part data, which
     shard_map's one-trace-for-all-shards model can't make static) and the
-    single-device r==128 hub levels (normally tiny)."""
+    single-device r==128 hub levels (normally tiny). ``advice`` lets the
+    caller append a remediation hint."""
     if nrows * BLOCK * 4 > GATHER_TABLE_BYTES:
         import warnings
 
@@ -315,7 +316,7 @@ def _warn_big_table(nrows: int, what: str):
             f"{what}: boundary-extraction table is "
             f"{nrows * BLOCK * 4 >> 20} MB, above the "
             f"~{GATHER_TABLE_BYTES >> 20} MB gather cliff — extraction "
-            f"will run ~4x off-rate",
+            f"will run ~4x off-rate{advice}",
             stacklevel=3,
         )
 

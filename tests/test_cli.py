@@ -109,3 +109,55 @@ def test_cli_save_resume(graphs, tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert "[PASS]" in r.stdout
+
+
+def test_cli_pagerank_tiled_default_and_flat_override(graphs):
+    """-layout auto (default) routes SpMV-shaped programs through the
+    tiled hybrid executor (VERDICT r1: the benched fast path must be
+    reachable from the apps), caching the plan next to the graph."""
+    r = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout
+    assert "hybrid plan" in r.stderr
+    plans = [p for p in os.listdir(graphs) if ".plan_" in p]
+    assert plans, "plan cache file not written next to the graph"
+    # Second run loads the cached plan (no re-planning log line).
+    r2 = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-check",
+    )
+    assert r2.returncode == 0, r2.stderr
+    assert "[PASS]" in r2.stdout
+    # Flat override still works and passes the same check.
+    r3 = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-check",
+        "-layout", "flat",
+    )
+    assert r3.returncode == 0, r3.stderr
+    assert "[PASS]" in r3.stdout
+    assert "hybrid plan" not in r3.stderr
+
+
+def test_cli_pagerank_tiled_sharded(graphs):
+    """-parts 8 + tiled layout = ShardedTiledExecutor on the CPU mesh."""
+    r = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "5", "-parts", "8",
+        "-layout", "tiled", "-check",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "[PASS]" in r.stdout
+    assert "hybrid plan" in r.stderr
+
+
+def test_cli_layout_tiled_rejects_non_spmv(graphs):
+    r = run_cli(
+        "lux_tpu.models.colfilter",
+        "-file", str(graphs / "w.lux"), "-ni", "2", "-layout", "tiled",
+    )
+    assert r.returncode != 0
+    assert "not SpMV-shaped" in r.stderr

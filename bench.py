@@ -77,7 +77,7 @@ def main():
         )
         lev_tag = "_".join(f"{r}x{t}" for r, t in levels)
         plan_path = os.path.join(
-            cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.npz"
+            cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.luxplan"
         )
         t0 = time.time()
         plan = get_cached_plan(

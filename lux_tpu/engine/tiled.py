@@ -65,6 +65,13 @@ def get_cached_plan(
     from lux_tpu.ops.tiled_spmv import load_plan, save_plan
 
     say = log if log is not None else (lambda *_: None)
+    if not os.path.exists(path) and path.endswith(".luxplan"):
+        # Round-1 caches used a single .npz at the same key; serve them
+        # rather than replanning (load_plan keeps the legacy reader).
+        legacy = path[: -len(".luxplan")] + ".npz"
+        if os.path.exists(legacy):
+            say(f"serving legacy plan cache {legacy}")
+            path = legacy
     if os.path.exists(path):
         plan = None
         try:

@@ -124,7 +124,7 @@ def _tiled_plan(g, program, args, log):
         args.file
         + ".plan_"
         + "_".join(f"{r}x{t}" for r, t in levels)
-        + f"_{args.tile_mb}.npz"
+        + f"_{args.tile_mb}.luxplan"
     )
     with Timer() as t:
         plan = get_cached_plan(

@@ -140,6 +140,37 @@ def test_plan_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 
+def test_plan_legacy_npz_load(tmp_path):
+    # Round-1 caches are single .npz files; load_plan keeps that reader
+    # and get_cached_plan probes the legacy key before replanning.
+    from lux_tpu.engine.tiled import get_cached_plan
+    from lux_tpu.ops.tiled_spmv import load_plan
+
+    g = generate.rmat(9, 8, seed=3)
+    plan = plan_hybrid(g, levels=((8, 2),))
+    legacy = str(tmp_path / "plan.npz")
+    data = dict(
+        nv=plan.nv, nvb=plan.nvb, order=plan.order, rank=plan.rank,
+        nlevels=len(plan.levels),
+        tail_sb=plan.tail_sb, tail_lane=plan.tail_lane,
+        tail_row_ptr=plan.tail_row_ptr,
+        out_degrees=plan.out_degrees, in_degrees=plan.in_degrees,
+    )
+    for i, lev in enumerate(plan.levels):
+        data[f"lev{i}_r"] = lev.r
+        data[f"lev{i}_strips"] = lev.strips
+        data[f"lev{i}_rows"] = lev.rows
+        data[f"lev{i}_cols"] = lev.cols
+    np.savez(legacy, **data)
+    back = load_plan(legacy)
+    assert plan_edge_multiset(back) == plan_edge_multiset(plan)
+    served = get_cached_plan(
+        g, str(tmp_path / "plan.luxplan"), levels=((8, 2),)
+    )
+    np.testing.assert_array_equal(served.order, plan.order)
+    np.testing.assert_array_equal(served.tail_sb, plan.tail_sb)
+
+
 def test_hybrid_run_resumes_from_external_vals():
     g = generate.rmat(9, 8, seed=5)
     ex = TiledPullExecutor(g, PageRank(), levels=((8, 1),), chunk_tail=64)

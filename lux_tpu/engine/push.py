@@ -81,6 +81,37 @@ class PushState(NamedTuple):
     frontier: jnp.ndarray   # bool, same shape
 
 
+def _sparse_budgets(nv: int, ne: int, queue_frac: int, edge_budget_frac: int):
+    """(queue capacity, edge budget) for the bounded sparse frontier.
+
+    Shared by the single-device and sharded executors so both pick the
+    sparse branch under identical conditions. Mirrors the reference's
+    per-part sparse queue sizing (nv/SPARSE_THRESHOLD + slack,
+    push_model.inl:390-412)."""
+    return nv // queue_frac + 128, max(ne // edge_budget_frac, 1024)
+
+
+def _queue_edge_slots(start, deg, E: int, ne_cap: int):
+    """Static-shape expansion of a bounded queue's edge ranges.
+
+    Given per-queue-slot CSR ``start`` offsets and ``deg`` degrees, lay
+    the queued vertices' edges head-to-head into ``E`` static edge slots:
+    returns (slot, edge_pos, emask) where ``slot[e]`` is the queue slot
+    owning edge slot e, ``edge_pos[e]`` its position in the edge arrays
+    (clipped into [0, ne_cap)), and ``emask`` marks live slots. The
+    caller must mask candidates/destinations with ``emask``."""
+    offs = jnp.concatenate([jnp.zeros(1, deg.dtype), jnp.cumsum(deg)])
+    total = offs[-1]
+    marks = jnp.zeros(E + 1, jnp.int32).at[
+        jnp.clip(offs[:-1], 0, E)
+    ].add(1, mode="drop")
+    slot = jnp.clip(jnp.cumsum(marks[:E]) - 1, 0, start.shape[0] - 1)
+    e_idx = jnp.arange(E, dtype=offs.dtype)
+    emask = e_idx < total
+    edge_pos = jnp.clip(start[slot] + (e_idx - offs[slot]), 0, ne_cap - 1)
+    return slot, edge_pos, emask
+
+
 def _chunk_while(one_iter, state: PushState, k: int, limit):
     """Run up to ``min(k, limit)`` fixpoint iterations on-device with
     early exit.
@@ -91,24 +122,31 @@ def _chunk_while(one_iter, state: PushState, k: int, limit):
     loop runs under ``lax.while_loop`` and the host syncs once per chunk.
     ``k`` is static (compiled once); ``limit`` is a traced bound so partial
     final chunks reuse the same executable instead of recompiling.
-    Returns (state, counts[k], iters_done, last_count).
+    ``one_iter`` returns (state, count, took_sparse); returns
+    (state, counts[k], sparse_flags[k], iters_done, last_count).
     """
 
     def cond(carry):
-        _, i, last, _ = carry
+        _, i, last, _, _ = carry
         return (i < jnp.minimum(k, limit)) & (last > 0)
 
     def body(carry):
-        st, i, _, counts = carry
-        st, cnt = one_iter(st)
+        st, i, _, counts, flags = carry
+        st, cnt, sp = one_iter(st)
         counts = jax.lax.dynamic_update_index_in_dim(
             counts, cnt, i, axis=0
         )
-        return st, i + 1, cnt, counts
+        flags = jax.lax.dynamic_update_index_in_dim(
+            flags, sp, i, axis=0
+        )
+        return st, i + 1, cnt, counts, flags
 
-    init = (state, jnp.int32(0), jnp.int32(1), jnp.zeros(k, jnp.int32))
-    st, done, last, counts = jax.lax.while_loop(cond, body, init)
-    return st, counts, done, last
+    init = (
+        state, jnp.int32(0), jnp.int32(1),
+        jnp.zeros(k, jnp.int32), jnp.zeros(k, jnp.int32),
+    )
+    st, done, last, counts, flags = jax.lax.while_loop(cond, body, init)
+    return st, counts, flags, done, last
 
 
 class PushExecutor:
@@ -154,10 +192,9 @@ class PushExecutor:
             dg["weights"] = put(graph.weights)
         self.sparse = sparse and graph.ne >= 1024
         if self.sparse:
-            # Queue capacity mirrors the reference's per-part sparse queue
-            # sizing (nv/SPARSE_THRESHOLD + slack, push_model.inl:390-412).
-            self.queue_cap = int(graph.nv) // queue_frac + 128
-            self.edge_budget = max(int(graph.ne) // edge_budget_frac, 1024)
+            self.queue_cap, self.edge_budget = _sparse_budgets(
+                int(graph.nv), int(graph.ne), queue_frac, edge_budget_frac
+            )
             from lux_tpu.engine.pull import _edge_index_dtype
 
             csr = graph.csr()
@@ -204,18 +241,9 @@ class PushExecutor:
         rp = dg["csr_row_ptr"]
         start = rp[q]
         deg = rp[jnp.minimum(q + 1, nv)] - start
-        offs = jnp.concatenate([jnp.zeros(1, deg.dtype), jnp.cumsum(deg)])
-        total = offs[-1]
         # 2. Edge slot → queue slot: mark segment starts, prefix-sum.
-        marks = jnp.zeros(E + 1, jnp.int32).at[
-            jnp.clip(offs[:-1], 0, E)
-        ].add(1, mode="drop")
-        slot = jnp.cumsum(marks[:E]) - 1                      # (E,)
-        e_idx = jnp.arange(E, dtype=offs.dtype)
-        emask = e_idx < total
-        slot = jnp.clip(slot, 0, Q - 1)
-        edge_pos = jnp.clip(
-            start[slot] + (e_idx - offs[slot]), 0, max(self.graph.ne - 1, 0)
+        slot, edge_pos, emask = _queue_edge_slots(
+            start, deg, E, max(self.graph.ne, 1)
         )
         dst = dg["csr_col_dst"][edge_pos]
         src_vals = values[jnp.clip(q[slot], 0, nv - 1)]
@@ -237,7 +265,8 @@ class PushExecutor:
 
     def _one_iter(self, state: PushState, dg):
         if not self.sparse:
-            return self._dense_iter(state, dg)
+            st, cnt = self._dense_iter(state, dg)
+            return st, cnt, jnp.int32(0)
         cnt = state.frontier.sum(dtype=jnp.int32)
         # uint32 sum is exact for any total <= 2^32 > ne, so the sparse
         # branch (only correct when total fits the edge budget) can never
@@ -248,15 +277,17 @@ class PushExecutor:
         use_sparse = (cnt <= self.queue_cap) & (
             out_edges <= jnp.uint32(self.edge_budget)
         )
-        return jax.lax.cond(
+        st, ncnt = jax.lax.cond(
             use_sparse,
             lambda st: self._sparse_iter(st, dg),
             lambda st: self._dense_iter(st, dg),
             state,
         )
+        return st, ncnt, use_sparse.astype(jnp.int32)
 
     def _step_impl(self, state: PushState, dg):
-        return self._one_iter(state, dg)
+        st, cnt, _ = self._one_iter(state, dg)
+        return st, cnt
 
     def _chunk_impl(self, state: PushState, dg, k: int, limit=None):
         one_iter = lambda st: self._one_iter(st, dg)
@@ -287,10 +318,15 @@ class PushExecutor:
         """Iterate to fixpoint; returns (final_state, iterations_run).
 
         Runs ``chunk`` iterations per device dispatch with on-device early
-        exit; the host reads back one count batch per chunk."""
+        exit; the host reads back one count batch per chunk. The number of
+        iterations served by the sparse (push-direction) branch is left in
+        ``self.sparse_iters`` after each run."""
         if state is None:
             state = self.init_state(**init_kw)
-        return _run_to_fixpoint(self._multi, state, max_iters, chunk, verbose)
+        state, total, self.sparse_iters = _run_to_fixpoint(
+            self._multi, state, max_iters, chunk, verbose
+        )
+        return state, total
 
     def _multi(self, state: PushState, limit: int, k: int):
         return self._multi_jit(state, self._dg, k, limit=jnp.int32(limit))
@@ -306,33 +342,55 @@ class PushExecutor:
 
 def _run_to_fixpoint(multi, state, max_iters, chunk, verbose):
     total = 0
+    sparse_total = 0
     while True:
         limit = chunk if max_iters is None else min(chunk, max_iters - total)
         if limit <= 0:
             break
         k = chunk
-        state, counts, done, last = multi(state, limit, k)
+        state, counts, flags, done, last = multi(state, limit, k)
         # One batched transfer: on a tunneled TPU every device_get is a
-        # full round-trip (~tens of ms), so fetch all three together.
-        counts_h, done_h, last_h = jax.device_get((counts, done, last))
+        # full round-trip (~tens of ms), so fetch everything together.
+        counts_h, flags_h, done_h, last_h = jax.device_get(
+            (counts, flags, done, last)
+        )
         done_i = int(np.asarray(done_h).reshape(-1)[0])
         last_i = int(np.asarray(last_h).reshape(-1)[0])
+        fl = np.asarray(flags_h).reshape(-1, k)[0][:done_i]
+        sparse_total += int(fl.sum())
         if verbose:
             ch = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
             for j, c in enumerate(ch):
-                print(f"iter {total + j}: active {int(c)}")
+                branch = "sparse" if fl[j] else "dense"
+                print(f"iter {total + j}: active {int(c)} [{branch}]")
         total += done_i
         if last_i == 0 or done_i == 0:
             break
     hard_sync(state.values)
-    return state, total
+    return state, total, sparse_total
 
 
 class ShardedPushExecutor:
-    """Push executor over an N-device mesh: the ghost/frontier exchange is
-    one fused all-gather of (values, frontier) shards — the analogue of the
-    reference's whole-region old-value + old-frontier ZC reads
-    (push_model.inl:234-241, 250-257)."""
+    """Push executor over an N-device mesh with the same two per-iteration
+    strategies as the single-device engine, chosen on-device each
+    iteration (the reference's push engine is identical single- vs
+    multi-GPU for the same reason, core/push_model.inl):
+
+    - **dense**: all-gather full (values, frontier) shards and run the
+      masked pull-direction relax over local CSC in-edges — the analogue
+      of the whole-region old-value + old-frontier ZC reads
+      (push_model.inl:234-241, 250-257).
+    - **sparse**: each shard compacts its local frontier into a bounded
+      queue, the queues (+ queued values) are all-gathered — the analogue
+      of streaming every part's frontier chunk H2D (sssp_gpu.cu:424-458)
+      — and each shard expands the global queue against its local edges
+      via a per-shard CSR keyed by *global* source id (the replicated
+      push row-ptr, push_model.inl:321-324,449-465). Exchange and
+      expansion cost scale with the frontier, not nv/ne.
+
+    The branch is picked by replicated collectives (pmax of local
+    frontier counts, psum of frontier out-edges) so every shard takes the
+    same ``lax.cond`` side."""
 
     def __init__(
         self,
@@ -340,6 +398,9 @@ class ShardedPushExecutor:
         program: PushProgram,
         mesh: Optional[Mesh] = None,
         num_parts: Optional[int] = None,
+        sparse: bool = True,
+        queue_frac: int = 16,       # per-shard queue = max_nv/queue_frac + slack
+        edge_budget_frac: int = 8,  # per-shard edge budget = max_ne/frac
     ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
@@ -357,6 +418,20 @@ class ShardedPushExecutor:
         }
         if self.sg.weights is not None:
             self._dg["weights"] = put(self.sg.weights)
+        self.sparse = sparse and graph.ne >= 1024
+        if self.sparse:
+            self.queue_cap, self.edge_budget = _sparse_budgets(
+                self.sg.max_nv, self.sg.max_ne, queue_frac, edge_budget_frac
+            )
+            prp, pdst, pw = self.sg.build_push_csr()
+            self._dg["push_row_ptr"] = put(prp)
+            self._dg["push_dst_local"] = put(pdst)
+            if pw is not None:
+                self._dg["push_weights"] = put(pw)
+            self._dg["out_degrees"] = put(self.sg.out_degrees)
+            self._dg["row_left"] = put(
+                self.sg.row_left.astype(np.int32)[:, None]
+            )
         self._specs = {k: P(PARTS_AXIS) for k in self._dg}
         state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
         mapped = jax.shard_map(
@@ -369,8 +444,8 @@ class ShardedPushExecutor:
         self._chunk_cache = {}
 
     def _iter_block(self, state: PushState, dg):
-        """One iteration on this shard's (1, ...) blocks; returns the new
-        blocks and the *local* new-frontier count."""
+        """One dense iteration on this shard's (1, ...) blocks; returns the
+        new blocks and the *local* new-frontier count."""
         prog = self.program
         max_nv = self.sg.max_nv
         v = state.values[0]
@@ -398,17 +473,96 @@ class ShardedPushExecutor:
         cnt = frontier.sum(dtype=jnp.int32)
         return PushState(new[None], frontier[None]), cnt
 
+    def _sparse_block(self, state: PushState, dg):
+        """One sparse iteration: bounded local queue → all-gather of
+        (global ids, queued values) → expansion of the global queue
+        against this shard's local edges through the push CSR."""
+        prog = self.program
+        nv, max_nv = self.graph.nv, self.sg.max_nv
+        Q, E = self.queue_cap, self.edge_budget
+        v = state.values[0]
+        f = state.frontier[0]
+        # 1. Local frontier → bounded queue of global ids + values.
+        q_loc = jnp.nonzero(f, size=Q, fill_value=max_nv)[0].astype(jnp.int32)
+        qv = v[jnp.clip(q_loc, 0, max_nv - 1)]
+        base = dg["row_left"][0, 0]
+        qg = jnp.where(q_loc >= max_nv, jnp.int32(nv), base + q_loc)
+        # 2. Exchange: the analogue of per-part frontier-chunk streaming
+        # (sssp_gpu.cu:424-458) — O(P*Q) bytes, not O(nv).
+        all_q = jax.lax.all_gather(qg, PARTS_AXIS).reshape(-1)    # (P*Q,)
+        all_qv = jax.lax.all_gather(qv, PARTS_AXIS).reshape(-1)
+        # 3. Expand against local edges via the global-src CSR. Sentinel
+        # id nv reads deg == 0 (row_ptr is padded with two n_e entries).
+        rp = dg["push_row_ptr"][0]
+        start = rp[all_q]
+        deg = rp[all_q + 1] - start
+        slot, edge_pos, emask = _queue_edge_slots(
+            start, deg, E, self.sg.max_ne
+        )
+        dstl = dg["push_dst_local"][0][edge_pos]
+        w = (
+            dg["push_weights"][0][edge_pos]
+            if "push_weights" in dg else None
+        )
+        cand = prog.relax(all_qv[slot], w)
+        ident = identity_for(prog.combiner, cand.dtype)
+        cand = jnp.where(emask, cand, ident)
+        dstl = jnp.where(emask, dstl, max_nv)
+        # 4. Deterministic scatter-combine into local values (pad slot
+        # max_nv swallows masked edges).
+        vv = jnp.concatenate([v, jnp.full((1,), ident, v.dtype)])
+        if prog.combiner == "min":
+            new = vv.at[dstl].min(cand)[:max_nv]
+        else:
+            new = vv.at[dstl].max(cand)[:max_nv]
+        vmask = dg["vertex_mask"][0]
+        new = jnp.where(vmask, new, v)
+        frontier = (new != v) & vmask
+        cnt = frontier.sum(dtype=jnp.int32)
+        return PushState(new[None], frontier[None]), cnt
+
+    def _one_iter_block(self, state: PushState, dg):
+        """Adaptive per-iteration branch; returns (state, local count,
+        took_sparse). The decision inputs are replicated collectives, so
+        every shard takes the same branch."""
+        if not self.sparse:
+            st, cnt = self._iter_block(state, dg)
+            return st, cnt, jnp.int32(0)
+        f = state.frontier[0]
+        cnt_loc = f.sum(dtype=jnp.int32)
+        oe_loc = jnp.where(
+            f, dg["out_degrees"][0].astype(jnp.uint32), 0
+        ).sum(dtype=jnp.uint32)
+        cnt_max = jax.lax.pmax(cnt_loc, PARTS_AXIS)
+        oe_tot = jax.lax.psum(oe_loc, PARTS_AXIS)
+        # Every shard's expansion is bounded by the GLOBAL frontier
+        # out-edge total (its local degrees sum to the global ones), so
+        # one conservative test keeps all shards inside the static queue
+        # and edge budgets.
+        use_sparse = (cnt_max <= self.queue_cap) & (
+            oe_tot <= jnp.uint32(self.edge_budget)
+        )
+        st, ncnt = jax.lax.cond(
+            use_sparse,
+            lambda s: self._sparse_block(s, dg),
+            lambda s: self._iter_block(s, dg),
+            state,
+        )
+        return st, ncnt, use_sparse.astype(jnp.int32)
+
     def _shard_step(self, state: PushState, dg):
-        new_state, cnt = self._iter_block(state, dg)
+        new_state, cnt, _ = self._one_iter_block(state, dg)
         return new_state, cnt[None]
 
     def _shard_chunk(self, state: PushState, dg, limit, k: int):
         def one_iter(st):
-            new_state, cnt_local = self._iter_block(st, dg)
-            return new_state, jax.lax.psum(cnt_local, PARTS_AXIS)
+            new_state, cnt_local, sp = self._one_iter_block(st, dg)
+            return new_state, jax.lax.psum(cnt_local, PARTS_AXIS), sp
 
-        st, counts, done, last = _chunk_while(one_iter, state, k, limit[0])
-        return st, counts[None], done[None], last[None]
+        st, counts, flags, done, last = _chunk_while(
+            one_iter, state, k, limit[0]
+        )
+        return st, counts[None], flags[None], done[None], last[None]
 
     def _multi(self, state: PushState, limit: int, k: int):
         if k not in self._chunk_cache:
@@ -419,6 +573,7 @@ class ShardedPushExecutor:
                 in_specs=(state_spec, self._specs, P()),
                 out_specs=(
                     state_spec,
+                    P(PARTS_AXIS),
                     P(PARTS_AXIS),
                     P(PARTS_AXIS),
                     P(PARTS_AXIS),
@@ -458,7 +613,10 @@ class ShardedPushExecutor:
     ):
         if state is None:
             state = self.init_state(**init_kw)
-        return _run_to_fixpoint(self._multi, state, max_iters, chunk, verbose)
+        state, total, self.sparse_iters = _run_to_fixpoint(
+            self._multi, state, max_iters, chunk, verbose
+        )
+        return state, total
 
     def warmup(self, chunk: int = 16, **init_kw):
         _run_to_fixpoint(

@@ -156,6 +156,45 @@ class ShardedGraph:
             row_left=row_left_full,
         )
 
+    # -- push-direction (CSR-by-global-src) view -------------------------
+
+    def build_push_csr(self):
+        """Per-shard CSR of the part's edges keyed by *global* source id.
+
+        The reference gives every GPU a full global push row-pointer array
+        restricted to its local edge set (the ``nv * numParts`` region,
+        core/push_model.inl:321-324,449-465) so any device can expand any
+        frontier vertex against its local edges. Same here: shard p's
+        ``push_row_ptr`` spans all nv global sources (+2 pad entries so the
+        sentinel id ``nv`` reads zero degree), and ``push_dst_local``/
+        ``push_weights`` hold the part's edges re-sorted by source.
+
+        Returns (push_row_ptr (P, nv+2) int32, push_dst_local (P, max_ne)
+        int32 with pad == max_nv, push_weights (P, max_ne) int32 or None).
+        """
+        P, nv = self.num_parts, self.graph.nv
+        rp = np.zeros((P, nv + 2), dtype=np.int32)
+        dstl = np.full((P, self.max_ne), self.max_nv, dtype=np.int32)
+        w = (
+            np.zeros((P, self.max_ne), dtype=np.int32)
+            if self.weights is not None
+            else None
+        )
+        for p in range(P):
+            m = self.edge_mask[p]
+            n_e = int(m.sum())
+            if n_e == 0:
+                continue
+            srcs = self.src_global[p, :n_e].astype(np.int64)
+            order = np.argsort(srcs, kind="stable")
+            dstl[p, :n_e] = self.dst_local[p, :n_e][order]
+            if w is not None:
+                w[p, :n_e] = self.weights[p, :n_e][order]
+            counts = np.bincount(srcs, minlength=nv)
+            rp[p, 1 : nv + 1] = np.cumsum(counts)
+            rp[p, nv + 1] = n_e
+        return rp, dstl, w
+
     # -- host value layout conversions ----------------------------------
 
     def to_padded(self, global_vals: np.ndarray) -> np.ndarray:

@@ -104,6 +104,49 @@ def test_sharded_cc_parity(parts):
     np.testing.assert_array_equal(got, reference_components(g))
 
 
+@pytest.mark.parametrize("parts", [2, 8])
+def test_sharded_sparse_branch_taken_and_correct(parts):
+    """The distributed frontier path: late small-frontier iterations must
+    run through the sparse branch (bounded queue + push-CSR expansion)
+    and still reach the exact oracle fixpoint."""
+    g = generate.gnp(2000, 16000, seed=31)
+    ex = ShardedPushExecutor(
+        g, SSSP(), mesh=make_mesh(parts), queue_frac=4, edge_budget_frac=2
+    )
+    state, iters = ex.run(start=0)
+    assert ex.sparse_iters > 0, "sparse branch never taken"
+    assert ex.sparse_iters < iters, "dense fallback never taken"
+    got = ex.gather_values(state)
+    np.testing.assert_array_equal(got, reference_sssp(g, start=0))
+
+
+def test_sharded_sparse_long_chain_all_sparse():
+    # Single-vertex frontier each iteration: every iteration should take
+    # the sparse branch on the mesh, like the single-device equivalent.
+    g = generate.path_graph(1100)
+    ex = ShardedPushExecutor(g, SSSP(), mesh=make_mesh(4), queue_frac=1)
+    assert ex.sparse
+    state, iters = ex.run(start=0)
+    assert ex.sparse_iters == iters
+    np.testing.assert_array_equal(
+        ex.gather_values(state), np.arange(1100, dtype=np.uint32)
+    )
+
+
+def test_sharded_sparse_weighted_cc():
+    # CC's dense initial frontier must fall back dense on iter 1 on the
+    # mesh too, then the label fixpoint must match the oracle.
+    g = generate.undirected(generate.gnp(600, 1200, seed=33, weighted=True))
+    ex = ShardedPushExecutor(
+        g, ConnectedComponents(), mesh=make_mesh(8), queue_frac=2,
+        edge_budget_frac=1,
+    )
+    state, iters = ex.run()
+    assert ex.sparse_iters < iters, "dense fallback never taken"
+    got = ex.gather_values(state)
+    np.testing.assert_array_equal(got, reference_components(g))
+
+
 def test_chunked_halt_runs_exact_fixpoint():
     # Fixpoint must be unchanged by chunked on-device early-exit iteration.
     g = generate.path_graph(20)

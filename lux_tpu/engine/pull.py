@@ -109,8 +109,76 @@ class _DeviceGraph:
     in_degrees: jnp.ndarray       # (nv,) int32
 
 
+@dataclasses.dataclass
+class _ChunkedGraph:
+    """CSC arrays chunked for a ``lax.scan`` over edge windows, plus the
+    per-chunk row-boundary plan (host-precomputed).
+
+    The flat engine materializes the full (ne, *value_shape) contribution
+    array; at NetFlix scale (201M edges x K=20 f32 = 16 GB) that exceeds
+    HBM (cf. the reference's full-nv H2D per iteration instead,
+    col_filter/colfilter.cc driver). Here contributions only ever exist
+    as one (C, K) chunk inside the scan; per-destination sums come from
+    chunk-local cumsums gathered at the row boundaries falling in each
+    chunk (``bnd_pos``, a scan input) and rebased across chunks with a
+    double-single prefix over chunk totals — the K-wide generalization of
+    the tiled engine's Z-stream reduction (ops/tiled_spmv.py), with
+    dynamic per-chunk boundaries instead of plan-time-static ones.
+    """
+
+    col_src: jnp.ndarray          # (nchunks, C) int32, pad 0
+    seg_ids: jnp.ndarray          # (nchunks, C) int32, pad 0
+    weights: Optional[jnp.ndarray]   # (nchunks, C) or None
+    bnd_pos: jnp.ndarray          # (nchunks, R) int32 local cumsum positions
+    gather_idx: jnp.ndarray       # (nv+1,) int32 into (nchunks*R,) emits
+    bnd_chunk: jnp.ndarray        # (nv+1,) int32 chunk of each boundary
+    out_degrees: jnp.ndarray      # (nv,) int32
+    in_degrees: jnp.ndarray       # (nv,) int32
+
+
+def _chunk_boundary_plan(row_ptr: np.ndarray, ne: int, chunk: int):
+    """Assign each of the nv+1 row boundaries to the edge chunk it falls
+    in. Returns (nchunks, bnd_pos (nchunks, R), gather_idx (nv+1,),
+    bnd_chunk (nv+1,)); R is the worst-case boundaries per chunk."""
+    nchunks = max(-(-ne // chunk), 1)
+    rp = row_ptr.astype(np.int64)
+    cidx = np.minimum(rp // chunk, nchunks - 1)
+    lpos = (rp - cidx * chunk).astype(np.int32)          # ∈ [0, C]
+    cnt = np.bincount(cidx, minlength=nchunks)
+    starts = np.zeros(nchunks, np.int64)
+    np.cumsum(cnt[:-1], out=starts[1:])
+    rank = np.arange(rp.shape[0], dtype=np.int64) - starts[cidx]
+    r_max = max(int(cnt.max()), 1)
+    # The emit table is padded to the most boundary-dense chunk; if that
+    # approaches one slot per edge, chunking no longer compresses and the
+    # stacked emits would rival the flat (ne, K) array this path avoids.
+    if nchunks * r_max >= 2**31 or nchunks * r_max > max(ne, 1):
+        raise ValueError(
+            f"edge-chunked plan does not compress: {nchunks} chunks x "
+            f"{r_max} boundaries/chunk vs {ne} edges — a run of near-empty "
+            "rows packs too many boundaries into one chunk; raise the edge "
+            "chunk size or reorder vertices"
+        )
+    bnd_pos = np.zeros((nchunks, r_max), np.int32)
+    bnd_pos[cidx, rank] = lpos
+    gather_idx = (cidx * r_max + rank).astype(np.int32)
+    return nchunks, bnd_pos, gather_idx, cidx.astype(np.int32)
+
+
+# Auto edge-chunking threshold: flat contributions above this many bytes
+# route through the scan path (overridable via LUX_EDGE_CHUNK_BYTES).
+EDGE_CHUNK_AUTO_BYTES = 2 << 30
+DEFAULT_EDGE_CHUNK = 1 << 20
+
+
 class PullExecutor:
-    """Executes a pull program on a single device (CPU or one TPU chip)."""
+    """Executes a pull program on a single device (CPU or one TPU chip).
+
+    Sum-combiner programs whose flat (ne, *value_shape) contribution
+    array would exceed ~2 GB run edge-chunked (``_ChunkedGraph``): a
+    ``lax.scan`` over edge windows so NetFlix-scale CF (16 GB flat) fits
+    in HBM. ``edge_chunk`` forces chunked with the given window;
+    ``edge_chunk=0`` forces flat."""
 
     def __init__(
         self,
@@ -118,6 +186,7 @@ class PullExecutor:
         program: PullProgram,
         sum_strategy: str = "rowptr",   # 'rowptr' (scatter-free) | 'segment'
         device=None,
+        edge_chunk: Optional[int] = None,
     ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
@@ -126,21 +195,70 @@ class PullExecutor:
         self.sum_strategy = sum_strategy
         self.device = device
         put = lambda x: jax.device_put(jnp.asarray(x), device)
-        eidx = _edge_index_dtype(graph.ne)
-        self.dgraph = _DeviceGraph(
-            col_src=put(graph.col_src.astype(np.int32)),
-            seg_ids=put(graph.col_dst),
-            row_ptr=put(graph.row_ptr.astype(eidx)),
-            weights=None if graph.weights is None else put(graph.weights),
-            out_degrees=put(graph.out_degrees.astype(np.int32)),
-            in_degrees=put(graph.in_degrees.astype(np.int32)),
-        )
+
+        vshape = tuple(getattr(program, "value_shape", ()) or ())
+        width = int(np.prod(vshape)) if vshape else 1
+        if edge_chunk is None:
+            import os
+
+            limit = int(
+                os.environ.get("LUX_EDGE_CHUNK_BYTES", EDGE_CHUNK_AUTO_BYTES)
+            )
+            flat_bytes = graph.ne * width * np.dtype(np.float32).itemsize
+            self.edge_chunk = (
+                DEFAULT_EDGE_CHUNK
+                if (program.combiner == "sum" and flat_bytes > limit)
+                else 0
+            )
+        else:
+            self.edge_chunk = edge_chunk
+        if self.edge_chunk and program.combiner != "sum":
+            raise ValueError(
+                "edge-chunked execution needs a sum combiner "
+                f"({program.name} has {program.combiner!r})"
+            )
+
+        if self.edge_chunk:
+            C = self.edge_chunk
+            nchunks, bnd_pos, gidx, bchunk = _chunk_boundary_plan(
+                graph.row_ptr, graph.ne, C
+            )
+            pad = nchunks * C - graph.ne
+
+            def padded(a):
+                return np.pad(a, (0, pad)).reshape(nchunks, C)
+
+            self.dgraph = _ChunkedGraph(
+                col_src=put(padded(graph.col_src.astype(np.int32))),
+                seg_ids=put(padded(graph.col_dst.astype(np.int32))),
+                weights=(
+                    None if graph.weights is None
+                    else put(padded(graph.weights))
+                ),
+                bnd_pos=put(bnd_pos),
+                gather_idx=put(gidx),
+                bnd_chunk=put(bchunk),
+                out_degrees=put(graph.out_degrees.astype(np.int32)),
+                in_degrees=put(graph.in_degrees.astype(np.int32)),
+            )
+        else:
+            eidx = _edge_index_dtype(graph.ne)
+            self.dgraph = _DeviceGraph(
+                col_src=put(graph.col_src.astype(np.int32)),
+                seg_ids=put(graph.col_dst),
+                row_ptr=put(graph.row_ptr.astype(eidx)),
+                weights=None if graph.weights is None else put(graph.weights),
+                out_degrees=put(graph.out_degrees.astype(np.int32)),
+                in_degrees=put(graph.in_degrees.astype(np.int32)),
+            )
         self._step = jax.jit(self._step_impl, donate_argnums=0)
         self._jrun = make_fused_runner(self._step_impl)
 
     # -- the jitted iteration -------------------------------------------
 
-    def _step_impl(self, vals: jnp.ndarray, dg: _DeviceGraph) -> jnp.ndarray:
+    def _step_impl(self, vals: jnp.ndarray, dg) -> jnp.ndarray:
+        if self.edge_chunk:
+            return self._chunked_step_impl(vals, dg)
         prog = self.program
         edge = EdgeCtx(
             src_vals=vals[dg.col_src],
@@ -155,6 +273,61 @@ class PullExecutor:
                 contrib, dg.seg_ids, num_segments=self.graph.nv,
                 kind=prog.combiner,
             )
+        ctx = VertexCtx(
+            nv=self.graph.nv,
+            out_degrees=dg.out_degrees,
+            in_degrees=dg.in_degrees,
+        )
+        return prog.apply(vals, acc, ctx)
+
+    def _chunked_step_impl(
+        self, vals: jnp.ndarray, dg: _ChunkedGraph
+    ) -> jnp.ndarray:
+        """Scan over edge windows; contributions never materialize beyond
+        one (C, K) chunk. Per-destination sums are chunk-local cumsums
+        gathered at each chunk's row boundaries, rebased with a
+        double-single prefix over chunk totals (exactly the accuracy
+        ladder of ops/tiled_spmv.py — boundary-diff error scales with
+        chunk-local mass, not stream mass). Pad edges land after the last
+        real boundary, so their garbage contributions are never gathered,
+        and the polluted final chunk total is never used (the exclusive
+        prefix stops before it)."""
+        from lux_tpu.ops.tiled_spmv import _dd_prefix
+
+        prog = self.program
+        vshape = tuple(getattr(prog, "value_shape", ()) or ())
+        k = int(np.prod(vshape)) if vshape else 1
+
+        def body(_, ch):
+            cs, cd, w, bnd = ch
+            edge = EdgeCtx(
+                src_vals=vals[cs], dst_vals=vals[cd], weights=w,
+            )
+            contrib = prog.edge_contrib(edge)
+            c2 = contrib.reshape(contrib.shape[0], k)
+            z = jnp.cumsum(c2, axis=0)
+            zf = jnp.concatenate([jnp.zeros((1, k), z.dtype), z])
+            return 0, (zf[bnd], z[-1])
+
+        w = dg.weights
+        if w is None:
+            _, (zb, totals) = jax.lax.scan(
+                lambda c, ch: body(c, (ch[0], ch[1], None, ch[2])),
+                0, (dg.col_src, dg.seg_ids, dg.bnd_pos),
+            )
+        else:
+            _, (zb, totals) = jax.lax.scan(
+                body, 0, (dg.col_src, dg.seg_ids, w, dg.bnd_pos)
+            )
+        zg = zb.reshape(-1, k)[dg.gather_idx]           # (nv+1, k)
+        ph, pl = _dd_prefix(totals)                     # (nchunks+1, k)
+        ci = dg.bnd_chunk
+        acc = (
+            (zg[1:] - zg[:-1])
+            + (ph[ci[1:]] - ph[ci[:-1]])
+            + (pl[ci[1:]] - pl[ci[:-1]])
+        )
+        acc = acc.reshape((self.graph.nv,) + vshape)
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=dg.out_degrees,
@@ -196,5 +369,12 @@ jax.tree_util.register_dataclass(
     _DeviceGraph,
     data_fields=["col_src", "seg_ids", "row_ptr", "weights", "out_degrees",
                  "in_degrees"],
+    meta_fields=[],
+)
+
+jax.tree_util.register_dataclass(
+    _ChunkedGraph,
+    data_fields=["col_src", "seg_ids", "weights", "bnd_pos", "gather_idx",
+                 "bnd_chunk", "out_degrees", "in_degrees"],
     meta_fields=[],
 )

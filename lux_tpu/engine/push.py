@@ -205,6 +205,7 @@ class PushExecutor:
                 dg["csr_weights"] = put(csr.weights)
             dg["out_degrees"] = put(graph.out_degrees.astype(np.int32))
         self._dg = dg
+        self.sparse_iters = 0       # sparse-branch count of the last run()
         self._step = jax.jit(self._step_impl, donate_argnums=0)
         self._multi_jit = jax.jit(
             self._chunk_impl, donate_argnums=0, static_argnums=2
@@ -433,6 +434,7 @@ class ShardedPushExecutor:
                 self.sg.row_left.astype(np.int32)[:, None]
             )
         self._specs = {k: P(PARTS_AXIS) for k in self._dg}
+        self.sparse_iters = 0       # sparse-branch count of the last run()
         state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
         mapped = jax.shard_map(
             self._shard_step,

@@ -45,6 +45,51 @@ def test_cf_parity_sharded():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-7)
 
 
+def test_cf_parity_edge_chunked():
+    # The NetFlix-scale path: contributions never materialize beyond one
+    # (C, K) chunk. A tiny chunk forces many windows, exercising the
+    # boundary gather + double-single chunk-prefix rebase.
+    g = bipartite_ratings(seed=5)
+    flat = PullExecutor(g, CollaborativeFiltering(), edge_chunk=0)
+    chunked = PullExecutor(g, CollaborativeFiltering(), edge_chunk=128)
+    a = np.asarray(flat.run(5))
+    b = np.asarray(chunked.run(5))
+    np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(
+        b, reference_colfilter(g, 5), rtol=1e-4, atol=1e-7
+    )
+
+
+def test_edge_chunked_scalar_program():
+    # Chunked execution is program-generic for sum combiners: PageRank
+    # (scalar values, no weights) must agree with the flat engine.
+    from lux_tpu.models import PageRank
+
+    g = generate.rmat(10, 8, seed=3)
+    flat = PullExecutor(g, PageRank(), edge_chunk=0)
+    chunked = PullExecutor(g, PageRank(), edge_chunk=512)
+    np.testing.assert_allclose(
+        np.asarray(chunked.run(5)), np.asarray(flat.run(5)),
+        rtol=5e-5, atol=1e-9,
+    )
+
+
+def test_edge_chunked_auto_threshold(monkeypatch):
+    # Auto mode picks chunked exactly when the flat (ne, K) contribution
+    # array would cross LUX_EDGE_CHUNK_BYTES.
+    g = bipartite_ratings(seed=7)
+    flat_bytes = g.ne * 20 * 4
+    monkeypatch.setenv("LUX_EDGE_CHUNK_BYTES", str(flat_bytes + 1))
+    assert PullExecutor(g, CollaborativeFiltering()).edge_chunk == 0
+    monkeypatch.setenv("LUX_EDGE_CHUNK_BYTES", str(flat_bytes - 1))
+    ex = PullExecutor(g, CollaborativeFiltering())
+    assert ex.edge_chunk > 0
+    np.testing.assert_allclose(
+        np.asarray(ex.run(3)), reference_colfilter(g, 3),
+        rtol=1e-4, atol=1e-7,
+    )
+
+
 def test_cf_requires_weights():
     g = generate.gnp(50, 200, seed=1)  # unweighted
     with pytest.raises(ValueError):

@@ -115,6 +115,30 @@ def main():
         f"({elapsed/iters*1e3:.2f} ms/iter)",
         file=sys.stderr,
     )
+
+    # Achieved HBM bandwidth: primary per-iteration byte streams of the
+    # executor (strip arrays + per-strip x-row gathers + per-tail-edge
+    # row gather and metadata + boundary-extraction gathers + the apply
+    # pass), against the v5e spec peak. Attributes regressions: a GTEPS
+    # drop with flat GB/s means added bytes; with dropping GB/s, lost
+    # pipeline efficiency.
+    HBM_PEAK_GBPS = 819.0  # v5e HBM2E spec
+    if layout == "tiled":
+        p = ex.plan
+        tail_edges = p.tail_sb.shape[0]
+        nrb_rows = sum(
+            p.nvb * (128 // lev.r) for lev in p.levels
+        )
+        bytes_iter = (
+            p.strip_bytes                     # int8 strip reads
+            + p.num_strips * 512              # x-block row gather per strip
+            + tail_edges * (512 + 5)          # tail row gather + sb/lane
+            + (g.nv + 1 + nrb_rows) * 2 * 512  # boundary extraction gathers
+            + 4 * g.nv * 4                    # apply + output passes
+        )
+    else:
+        bytes_iter = g.ne * (512 + 8) + 4 * g.nv * 4
+    gbps = bytes_iter * iters / elapsed / 1e9
     print(
         json.dumps(
             {
@@ -123,6 +147,8 @@ def main():
                 "unit": "GTEPS",
                 "vs_baseline": round(gteps / PER_CHIP_BASELINE, 4),
                 "layout": layout,
+                "achieved_gbps": round(gbps, 1),
+                "hbm_peak_frac": round(gbps / HBM_PEAK_GBPS, 3),
             }
         )
     )

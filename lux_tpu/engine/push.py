@@ -211,63 +211,81 @@ class PushExecutor:
             self._chunk_impl, donate_argnums=0, static_argnums=2
         )
 
-    # -- dense (pull-direction) iteration --------------------------------
+    # -- dense (pull-direction) stages ------------------------------------
+    # Each strategy is three stages (load / comp / update) so the fused
+    # iteration and the `-verbose` phase_step share one implementation
+    # (the reference's phase split, sssp_gpu.cu:389-513).
 
-    def _dense_iter(self, state: PushState, dg):
+    def _d_load(self, state: PushState, dg):
+        return state.values[dg["col_src"]], state.frontier[dg["col_src"]]
+
+    def _d_comp(self, src_vals, src_front, dg):
         prog = self.program
-        src_vals = state.values[dg["col_src"]]
         cand = prog.relax(src_vals, dg.get("weights"))
         ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(state.frontier[dg["col_src"]], cand, ident)
-        acc = segment_reduce(
+        cand = jnp.where(src_front, cand, ident)
+        return segment_reduce(
             cand, dg["seg_ids"], num_segments=self.graph.nv,
             kind=prog.combiner,
         )
-        if prog.combiner == "min":
+
+    def _merge_update(self, state: PushState, acc):
+        if self.program.combiner == "min":
             new = jnp.minimum(state.values, acc)
         else:
             new = jnp.maximum(state.values, acc)
         frontier = new != state.values
         return PushState(new, frontier), frontier.sum(dtype=jnp.int32)
 
-    # -- sparse (push-direction) iteration -------------------------------
+    def _dense_iter(self, state: PushState, dg):
+        src_vals, src_front = self._d_load(state, dg)
+        return self._merge_update(state, self._d_comp(src_vals, src_front, dg))
 
-    def _sparse_iter(self, state: PushState, dg):
-        prog = self.program
-        nv, Q, E = self.graph.nv, self.queue_cap, self.edge_budget
-        values, frontier = state
-        # 1. Frontier → bounded queue (ids sorted ascending; pad slot nv).
-        q = jnp.nonzero(frontier, size=Q, fill_value=nv)[0].astype(jnp.int32)
-        # Padded row_ptr lookup: q == nv yields start == end == ne.
+    # -- sparse (push-direction) stages -----------------------------------
+
+    def _s_load(self, state: PushState, dg):
+        """Frontier → bounded queue (ids sorted ascending; pad slot nv)
+        plus per-slot CSR ranges (padded row_ptr: q == nv → deg 0)."""
+        nv, Q = self.graph.nv, self.queue_cap
+        q = jnp.nonzero(
+            state.frontier, size=Q, fill_value=nv
+        )[0].astype(jnp.int32)
         rp = dg["csr_row_ptr"]
         start = rp[q]
         deg = rp[jnp.minimum(q + 1, nv)] - start
-        # 2. Edge slot → queue slot: mark segment starts, prefix-sum.
+        return q, start, deg
+
+    def _s_comp(self, state: PushState, q, start, deg, dg):
+        prog = self.program
+        nv = self.graph.nv
         slot, edge_pos, emask = _queue_edge_slots(
-            start, deg, E, max(self.graph.ne, 1)
+            start, deg, self.edge_budget, max(self.graph.ne, 1)
         )
         dst = dg["csr_col_dst"][edge_pos]
-        src_vals = values[jnp.clip(q[slot], 0, nv - 1)]
+        src_vals = state.values[jnp.clip(q[slot], 0, nv - 1)]
         w = dg["csr_weights"][edge_pos] if "csr_weights" in dg else None
         cand = prog.relax(src_vals, w)
         ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(emask, cand, ident)
-        dst = jnp.where(emask, dst, 0)
-        # 3. Scatter-combine candidates into the values (deterministic in
-        # XLA, unlike the reference's atomicMin, sssp_gpu.cu:48-61).
-        if prog.combiner == "min":
-            new = values.at[dst].min(cand)
+        return jnp.where(emask, cand, ident), jnp.where(emask, dst, 0)
+
+    def _s_update(self, state: PushState, cand, dst):
+        """Deterministic scatter-combine into the values (unlike the
+        reference's atomicMin, sssp_gpu.cu:48-61)."""
+        if self.program.combiner == "min":
+            new = state.values.at[dst].min(cand)
         else:
-            new = values.at[dst].max(cand)
-        new_frontier = new != values
-        return PushState(new, new_frontier), new_frontier.sum(dtype=jnp.int32)
+            new = state.values.at[dst].max(cand)
+        frontier = new != state.values
+        return PushState(new, frontier), frontier.sum(dtype=jnp.int32)
+
+    def _sparse_iter(self, state: PushState, dg):
+        q, start, deg = self._s_load(state, dg)
+        cand, dst = self._s_comp(state, q, start, deg, dg)
+        return self._s_update(state, cand, dst)
 
     # -- adaptive combination --------------------------------------------
 
-    def _one_iter(self, state: PushState, dg):
-        if not self.sparse:
-            st, cnt = self._dense_iter(state, dg)
-            return st, cnt, jnp.int32(0)
+    def _decide_sparse(self, state: PushState, dg):
         cnt = state.frontier.sum(dtype=jnp.int32)
         # uint32 sum is exact for any total <= 2^32 > ne, so the sparse
         # branch (only correct when total fits the edge budget) can never
@@ -275,9 +293,15 @@ class PushExecutor:
         out_edges = jnp.where(
             state.frontier, dg["out_degrees"].astype(jnp.uint32), 0
         ).sum(dtype=jnp.uint32)
-        use_sparse = (cnt <= self.queue_cap) & (
+        return (cnt <= self.queue_cap) & (
             out_edges <= jnp.uint32(self.edge_budget)
         )
+
+    def _one_iter(self, state: PushState, dg):
+        if not self.sparse:
+            st, cnt = self._dense_iter(state, dg)
+            return st, cnt, jnp.int32(0)
+        use_sparse = self._decide_sparse(state, dg)
         st, ncnt = jax.lax.cond(
             use_sparse,
             lambda st: self._sparse_iter(st, dg),
@@ -293,6 +317,77 @@ class PushExecutor:
     def _chunk_impl(self, state: PushState, dg, k: int, limit=None):
         one_iter = lambda st: self._one_iter(st, dg)
         return _chunk_while(one_iter, state, k, limit)
+
+    def _phase_jits(self):
+        """Jitted wrappers of the shared load/comp/update stage methods
+        (one implementation for the fused iteration and the `-verbose`
+        phases — they cannot drift)."""
+        if not hasattr(self, "_jphase"):
+            self._jphase = {
+                "d_load": jax.jit(self._d_load),
+                "d_comp": jax.jit(self._d_comp),
+                "update": jax.jit(self._merge_update),
+            }
+            if self.sparse:
+                self._jphase.update(
+                    decide=jax.jit(self._decide_sparse),
+                    s_load=jax.jit(self._s_load),
+                    s_comp=jax.jit(self._s_comp),
+                    s_update=jax.jit(self._s_update),
+                )
+        return self._jphase
+
+    def warmup_phases(self, state: PushState):
+        """Compile every phase jit (both branches) outside any timed
+        region — mirrors warmup()'s contract that ELAPSED TIME excludes
+        compilation. ``state`` is read, never donated."""
+        j = self._phase_jits()
+        dg = self._dg
+        hard_sync(j["update"](state, j["d_comp"](*j["d_load"](state, dg), dg)))
+        if self.sparse:
+            jax.device_get(j["decide"](state, dg))
+            q, start, deg = j["s_load"](state, dg)
+            cand, dst = j["s_comp"](state, q, start, deg, dg)
+            hard_sync(j["s_update"](state, cand, dst))
+
+    def phase_step(self, state: PushState):
+        """One iteration as separately-timed load/comp/update dispatches —
+        the reference's per-iteration `-verbose` breakdown
+        (sssp/sssp_gpu.cu:516-518: activeNodes, loadTime, compTime,
+        updateTime). load = frontier staging (queue build or frontier
+        gather), comp = relax + reduce, update = value merge + new
+        frontier. Returns (new_state, active, info dict). Phase dispatch
+        breaks fusion; use run() for timed fixpoints."""
+        from lux_tpu.utils.timing import Timer
+
+        j = self._phase_jits()
+        dg = self._dg
+        use_sparse = bool(
+            jax.device_get(j["decide"](state, dg))
+        ) if self.sparse else False
+        times = {}
+        if use_sparse:
+            with Timer() as t:
+                q, start, deg = hard_sync(j["s_load"](state, dg))
+            times["loadTime"] = t.elapsed
+            with Timer() as t:
+                cand, dst = hard_sync(j["s_comp"](state, q, start, deg, dg))
+            times["compTime"] = t.elapsed
+            with Timer() as t:
+                new_state, cnt = hard_sync(j["s_update"](state, cand, dst))
+            times["updateTime"] = t.elapsed
+        else:
+            with Timer() as t:
+                sv, sf = hard_sync(j["d_load"](state, dg))
+            times["loadTime"] = t.elapsed
+            with Timer() as t:
+                acc = hard_sync(j["d_comp"](sv, sf, dg))
+            times["compTime"] = t.elapsed
+            with Timer() as t:
+                new_state, cnt = hard_sync(j["update"](state, acc))
+            times["updateTime"] = t.elapsed
+        times["branch"] = "sparse" if use_sparse else "dense"
+        return new_state, int(jax.device_get(cnt)), times
 
     def init_state(self, **kw) -> PushState:
         vals = jax.device_put(
@@ -312,7 +407,6 @@ class PushExecutor:
         self,
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
-        verbose: bool = False,
         chunk: int = 16,
         **init_kw,
     ):
@@ -325,7 +419,7 @@ class PushExecutor:
         if state is None:
             state = self.init_state(**init_kw)
         state, total, self.sparse_iters = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk, verbose
+            self._multi, state, max_iters, chunk
         )
         return state, total
 
@@ -336,12 +430,10 @@ class PushExecutor:
         """Run one throwaway iteration through the exact run() path so
         ELAPSED TIME excludes XLA compilation AND first-transfer setup
         (both disproportionately slow on tunneled backends)."""
-        _run_to_fixpoint(
-            self._multi, self.init_state(**init_kw), 1, chunk, False
-        )
+        _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
 
 
-def _run_to_fixpoint(multi, state, max_iters, chunk, verbose):
+def _run_to_fixpoint(multi, state, max_iters, chunk):
     total = 0
     sparse_total = 0
     while True:
@@ -359,11 +451,6 @@ def _run_to_fixpoint(multi, state, max_iters, chunk, verbose):
         last_i = int(np.asarray(last_h).reshape(-1)[0])
         fl = np.asarray(flags_h).reshape(-1, k)[0][:done_i]
         sparse_total += int(fl.sum())
-        if verbose:
-            ch = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
-            for j, c in enumerate(ch):
-                branch = "sparse" if fl[j] else "dense"
-                print(f"iter {total + j}: active {int(c)} [{branch}]")
         total += done_i
         if last_i == 0 or done_i == 0:
             break
@@ -609,21 +696,18 @@ class ShardedPushExecutor:
         self,
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
-        verbose: bool = False,
         chunk: int = 16,
         **init_kw,
     ):
         if state is None:
             state = self.init_state(**init_kw)
         state, total, self.sparse_iters = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk, verbose
+            self._multi, state, max_iters, chunk
         )
         return state, total
 
     def warmup(self, chunk: int = 16, **init_kw):
-        _run_to_fixpoint(
-            self._multi, self.init_state(**init_kw), 1, chunk, False
-        )
+        _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
 
     def gather_values(self, state: PushState) -> np.ndarray:
         return self.sg.from_padded(np.asarray(jax.device_get(state.values)))

@@ -169,16 +169,19 @@ class TiledPullExecutor:
 
     # -- the jitted iteration (internal vertex order) --------------------
 
-    def _step_impl(
-        self, vals, dhybrid, out_degrees, in_degrees
-    ) -> jnp.ndarray:
-        acc = hybrid_spmv(vals, dhybrid)
+    def _apply_acc(self, vals, acc, out_degrees, in_degrees):
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=out_degrees,
             in_degrees=in_degrees,
         )
         return self.program.apply(vals, acc, ctx)
+
+    def _step_impl(
+        self, vals, dhybrid, out_degrees, in_degrees
+    ) -> jnp.ndarray:
+        acc = hybrid_spmv(vals, dhybrid)
+        return self._apply_acc(vals, acc, out_degrees, in_degrees)
 
     # -- driver ----------------------------------------------------------
     # Every public entry point speaks EXTERNAL vertex ids, exactly like
@@ -200,6 +203,51 @@ class TiledPullExecutor:
         which converts once per call, not per step)."""
         internal = self._to_internal(jnp.asarray(vals), self.order)
         return self._to_external(self._step(internal), self.rank)
+
+    def phase_step(self, vals: jnp.ndarray):
+        """One iteration dispatched as separately-timed phases for
+        ``-verbose`` attribution (the analogue of the reference's
+        per-iteration loadTime/compTime/updateTime breakdown,
+        sssp/sssp_gpu.cu:516-518 — phase names follow this engine's
+        actual pipeline instead of the CUDA one). Returns
+        (new external vals, {phase: seconds}). Phase dispatch breaks
+        XLA's cross-phase fusion, so the sum runs slower than step()."""
+        from lux_tpu.ops.tiled_spmv import strips_sum, tail_sum, vals_to_x2d
+        from lux_tpu.utils.timing import Timer
+
+        if not hasattr(self, "_jphase"):
+            nv = self.graph.nv
+
+            # The same strips/tail/apply building blocks the fused step
+            # composes (hybrid_spmv) — phase timing cannot drift from it.
+            def strips_fn(v, dh):
+                return strips_sum(vals_to_x2d(v, dh), dh, nv)
+
+            def tail_fn(v, dh):
+                return tail_sum(vals_to_x2d(v, dh), dh)
+
+            def apply_fn(v, acc_s, acc_t, od, idg):
+                return self._apply_acc(v, acc_s + acc_t, od, idg)
+
+            self._jphase = (
+                jax.jit(strips_fn), jax.jit(tail_fn), jax.jit(apply_fn),
+            )
+
+        strips_fn, tail_fn, apply_fn = self._jphase
+        times = {}
+        internal = hard_sync(self._to_internal(jnp.asarray(vals), self.order))
+        with Timer() as t:
+            acc_s = hard_sync(strips_fn(internal, self.dhybrid))
+        times["strips"] = t.elapsed
+        with Timer() as t:
+            acc_t = hard_sync(tail_fn(internal, self.dhybrid))
+        times["tail"] = t.elapsed
+        with Timer() as t:
+            new = hard_sync(apply_fn(
+                internal, acc_s, acc_t, self.out_degrees, self.in_degrees
+            ))
+        times["apply"] = t.elapsed
+        return self._to_external(new, self.rank), times
 
     def warmup(self):
         """Compile the step and both permutation converters (run(1) with

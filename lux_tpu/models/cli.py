@@ -257,14 +257,36 @@ def run_pull_app(program, argv, oracle=None):
         if args.verbose:
             # Per-iteration timing (the reference's -verbose per-part
             # breakdown, sssp_gpu.cu:516-518). Disables pipelining: each
-            # iteration is synced to be measurable.
+            # iteration is synced to be measurable; executors with a
+            # phase_step additionally attribute the time to pipeline
+            # phases (separately dispatched, so the sum runs slower than
+            # the fused step).
             from lux_tpu.engine.pull import hard_sync
 
+            has_phases = hasattr(ex, "phase_step")
+            if has_phases and remaining:
+                # Compile the phase jits outside the timed region (the
+                # phase dispatches are separate executables from the
+                # fused step that warmup() compiled).
+                ex.phase_step(vals)
             with Timer() as t:
                 for i in range(remaining):
-                    with Timer() as ti:
-                        vals = hard_sync(ex.step(vals))
-                    print(f"iter {start_iter + i}: {ti.elapsed*1e3:.3f} ms")
+                    if has_phases:
+                        with Timer() as ti:
+                            vals, ph = ex.phase_step(vals)
+                        detail = " ".join(
+                            f"{k} {v*1e6:.0f}us" for k, v in ph.items()
+                        )
+                        print(
+                            f"iter {start_iter + i}: {detail} "
+                            f"(total {ti.elapsed*1e3:.3f} ms)"
+                        )
+                    else:
+                        with Timer() as ti:
+                            vals = hard_sync(ex.step(vals))
+                        print(
+                            f"iter {start_iter + i}: {ti.elapsed*1e3:.3f} ms"
+                        )
         else:
             with Timer() as t:
                 vals = ex.run(remaining, vals=vals)
@@ -339,6 +361,62 @@ def _host_to_device(ex, host_vals):
     return jax.device_put(jnp.asarray(host_vals))
 
 
+def _run_push_verbose(ex, state, max_iters, start_iter, init_kw):
+    """Per-iteration `-verbose` loop for push apps, reproducing the
+    reference's per-part breakdown (sssp/sssp_gpu.cu:516-518):
+
+    - single device: `activeNodes, loadTime, compTime, updateTime` per
+      iteration via the executor's separately-dispatched phase_step;
+    - sharded: one `part p: activeNodes` line per part per iteration
+      (phases are fused inside one SPMD program, so only wall time and
+      per-part active counts are separable).
+    Disables chunked pipelining; timing is per-iteration synced."""
+    import jax
+
+    if state is None:
+        state = ex.init_state(**init_kw)
+    iters = 0
+    has_phases = hasattr(ex, "phase_step")
+    # Compile outside the timed loop (warmup() only built the fused
+    # chunk executable; the phase jits and the sharded single-step are
+    # separate). The throwaway state absorbs any donation.
+    warm = ex.init_state(**init_kw)
+    if has_phases:
+        ex.warmup_phases(warm)
+    else:
+        ex.step(warm)
+    with Timer() as t:
+        while max_iters is None or iters < max_iters:
+            if has_phases:
+                state, cnt, ph = ex.phase_step(state)
+                print(
+                    f"iter {start_iter + iters}: activeNodes {cnt} "
+                    f"loadTime {ph['loadTime']*1e6:.0f}us "
+                    f"compTime {ph['compTime']*1e6:.0f}us "
+                    f"updateTime {ph['updateTime']*1e6:.0f}us "
+                    f"[{ph['branch']}]"
+                )
+                total = cnt
+            else:
+                with Timer() as ti:
+                    state, cnts = ex.step(state)
+                    cnts = np.asarray(jax.device_get(cnts)).reshape(-1)
+                for p, c in enumerate(cnts):
+                    print(
+                        f"iter {start_iter + iters} part {p}: "
+                        f"activeNodes {int(c)}"
+                    )
+                print(
+                    f"iter {start_iter + iters}: "
+                    f"{ti.elapsed*1e3:.3f} ms total"
+                )
+                total = int(cnts.sum())
+            iters += 1
+            if total == 0:
+                break
+    return state, iters, t
+
+
 def run_push_app(program, argv, supports_start: bool):
     from lux_tpu.engine.check import check as run_check
 
@@ -371,13 +449,15 @@ def run_push_app(program, argv, supports_start: bool):
     ex.warmup(**init_kw)
 
     with _profiler(args.profile):
-        with Timer() as t:
-            state, iters = ex.run(
-                max_iters=max_iters,
-                state=state,
-                verbose=args.verbose,
-                **init_kw,
+        if args.verbose:
+            state, iters, t = _run_push_verbose(
+                ex, state, max_iters, start_iter, init_kw
             )
+        else:
+            with Timer() as t:
+                state, iters = ex.run(
+                    max_iters=max_iters, state=state, **init_kw
+                )
     t.print_elapsed()
     print(f"iterations = {iters}")
     print_gteps(g, iters, t.elapsed)

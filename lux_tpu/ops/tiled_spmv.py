@@ -811,22 +811,34 @@ def lane_select_tail_sums(
     return y.at[xing_idx].add(corr.reshape(-1))
 
 
+def vals_to_x2d(vals: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
+    """(nv,) values → (nvb, 128) padded gather operand."""
+    pad = dh.nvb * BLOCK - vals.shape[0]
+    return jnp.pad(vals, (0, pad)).reshape(dh.nvb, BLOCK)
+
+
+def strips_sum(x2d: jnp.ndarray, dh: DeviceHybrid, nv: int) -> jnp.ndarray:
+    """Σ over all strip levels; (nv,) f32 (internal order)."""
+    acc = jnp.zeros(dh.nvb * BLOCK, jnp.float32)
+    for lev in dh.levels:
+        acc = acc + strip_level_spmv(x2d, lev, dh.nvb * (BLOCK // lev.r))
+    return acc[:nv]
+
+
+def tail_sum(x2d: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
+    """Σ over the lane-select tail; (nv,) f32 (internal order)."""
+    return lane_select_tail_sums(
+        x2d, dh.tail_sb, dh.tail_lane, dh.tail_bnd_row, dh.tail_bnd_grp,
+        dh.tail_xing_idx, dh.tail_xing_s0, dh.tail_xing_s1, dh.tail_segs,
+    )
+
+
 def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
     """Full Σ vals[src] per destination over all layouts; (nv,) f32 in,
     (nv,) f32 out (internal vertex order)."""
     nv = vals.shape[0]
-    pad = dh.nvb * BLOCK - nv
-    x2d = jnp.pad(vals, (0, pad)).reshape(dh.nvb, BLOCK)
-
-    acc = jnp.zeros(dh.nvb * BLOCK, jnp.float32)
-    for lev in dh.levels:
-        acc = acc + strip_level_spmv(x2d, lev, dh.nvb * (BLOCK // lev.r))
-    acc = acc[:nv]
-
-    return acc + lane_select_tail_sums(
-        x2d, dh.tail_sb, dh.tail_lane, dh.tail_bnd_row, dh.tail_bnd_grp,
-        dh.tail_xing_idx, dh.tail_xing_s0, dh.tail_xing_s1, dh.tail_segs,
-    )
+    x2d = vals_to_x2d(vals, dh)
+    return strips_sum(x2d, dh, nv) + tail_sum(x2d, dh)
 
 
 for _cls, _data, _meta in (

@@ -54,6 +54,7 @@ def get_cached_plan(
     levels: Sequence[Tuple[int, int]] = ((8, 2),),
     budget_bytes: int = 8 << 30,
     log=None,
+    cap: int = 15,
 ) -> HybridPlan:
     """Load the hybrid plan cached at ``path`` (validating it against the
     graph), else plan and save. Planning costs minutes of host time at
@@ -99,9 +100,18 @@ def get_cached_plan(
                 "— replanning"
             )
             plan = None
+        # A plan capped tighter than requested is servable (it just
+        # spilled a few more overflow edges to the tail); a looser cap
+        # would break nibble packing, so replan.
+        if plan is not None and plan.cap > cap:
+            say(
+                f"cached plan {path} has count cap {plan.cap}, requested "
+                f"<= {cap} (nibble packing needs <= 15) — replanning"
+            )
+            plan = None
         if plan is not None:
             return plan
-    plan = plan_hybrid(graph, levels=levels, budget_bytes=budget_bytes)
+    plan = plan_hybrid(graph, levels=levels, budget_bytes=budget_bytes, cap=cap)
     try:
         save_plan(path, plan)
     except OSError as e:
@@ -136,6 +146,7 @@ class TiledPullExecutor:
         chunk_tail: int = DEFAULT_CHUNK_TAIL,
         plan: Optional[HybridPlan] = None,
         device=None,
+        pack: Optional[bool] = None,
     ):
         require_spmv_program(program, "TiledPullExecutor", "PullExecutor")
         self.graph = graph
@@ -147,7 +158,8 @@ class TiledPullExecutor:
         p = self.plan
         put = lambda x: jax.device_put(jnp.asarray(x), device)
         self.dhybrid = DeviceHybrid.build(
-            p, chunk_strips=chunk_strips, chunk_tail=chunk_tail, device=device
+            p, chunk_strips=chunk_strips, chunk_tail=chunk_tail,
+            device=device, pack=pack,
         )
         self.out_degrees = put(p.out_degrees.astype(np.int32))
         self.in_degrees = put(p.in_degrees.astype(np.int32))

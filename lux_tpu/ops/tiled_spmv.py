@@ -114,6 +114,11 @@ class HybridPlan:
     tail_row_ptr: np.ndarray  # (nv+1,) int64
     out_degrees: np.ndarray  # (nv,) int64, internal order
     in_degrees: np.ndarray   # (nv,) int64, internal order
+    # Per-cell count cap used at plan time (excess spilled to the tail).
+    # cap <= 15 makes every even-r level nibble-packable on device
+    # (two strip rows per int8 byte — see pack_strips); legacy plans
+    # used 127 and stay unpacked.
+    cap: int = 15
 
     @property
     def num_strips(self) -> int:
@@ -151,13 +156,17 @@ def plan_hybrid(
     levels: Sequence[Tuple[int, int]] = ((8, 2),),
     budget_bytes: int = 8 << 30,
     reorder: str = "degree",
+    cap: int = 15,
 ) -> HybridPlan:
     """Partition edges into strip levels + a lane-select tail. Exact.
 
     ``levels`` is a sequence of ``(r, min_count)`` pairs, consumed in
     order: each level takes the strips (at granularity r x 128) holding
     at least ``min_count`` still-unassigned edges, densest first, within
-    what remains of ``budget_bytes``.
+    what remains of ``budget_bytes``. Cells holding more than ``cap``
+    parallel edges spill the excess to the tail; cap <= 15 halves the
+    device strip bytes via nibble packing (``budget_bytes`` counts
+    device bytes, so packing doubles how many strips fit).
     """
     nv = graph.nv
     nvb = (nv + BLOCK - 1) // BLOCK
@@ -182,6 +191,10 @@ def plan_hybrid(
                 cols=np.zeros(0, np.int32),
             ))
             continue
+        # Budget books UNPACKED int8 bytes — nibble packing is an opt-in
+        # device-build decision (measured negative, see DeviceHybrid.build)
+        # the planner cannot assume; packed builds simply use less HBM
+        # than budgeted.
         strip_bytes = r * BLOCK
         strip_id = (d // r).astype(np.int64) * nvb + (s >> 7)
         uniq_ids, counts = np.unique(strip_id, return_counts=True)
@@ -200,13 +213,13 @@ def plan_hybrid(
         uk, kc = np.unique(key, return_counts=True)
         strips = np.zeros((len(chosen), strip_bytes), np.int8)
         if len(uk):
-            strips.ravel()[uk] = np.minimum(kc, 127).astype(np.int8)
+            strips.ravel()[uk] = np.minimum(kc, cap).astype(np.int8)
 
-        # int8 overflow (>127 parallel edges in one cell): keep the excess.
+        # Count overflow (> cap parallel edges in one cell): keep the excess.
         spill_s = spill_d = np.empty(0, np.int32)
-        over = kc > 127
+        over = kc > cap
         if over.any():
-            reps = (kc[over] - 127).astype(np.int64)
+            reps = (kc[over] - cap).astype(np.int64)
             ok = uk[over]
             sid = chosen[ok // strip_bytes]
             c = ok % strip_bytes
@@ -223,7 +236,7 @@ def plan_hybrid(
             rows=(chosen // nvb).astype(np.int32),
             cols=(chosen % nvb).astype(np.int32),
         ))
-        remaining -= strips.nbytes
+        remaining -= len(chosen) * strip_bytes
         s = np.concatenate([s[~covered], spill_s])
         d = np.concatenate([d[~covered], spill_d])
 
@@ -251,6 +264,7 @@ def plan_hybrid(
         tail_row_ptr=tail_row_ptr,
         out_degrees=graph.out_degrees[order],
         in_degrees=graph.in_degrees[order],
+        cap=cap,
     )
 
 
@@ -283,6 +297,7 @@ def save_plan(path: str, plan: HybridPlan) -> None:
         nv=plan.nv, nvb=plan.nvb,
         levels=[lev.r for lev in plan.levels],
         level_edges=[lev.edges for lev in plan.levels],
+        cap=plan.cap,
     )
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f)
@@ -330,6 +345,7 @@ def load_plan(path: str, mmap: bool = True) -> HybridPlan:
         return HybridPlan(
             nv=int(meta["nv"]), nvb=int(meta["nvb"]),
             levels=levels,
+            cap=int(meta.get("cap", 127)),
             **{name: ld(name) for name in _PLAN_ARRAY_FIELDS},
         )
 
@@ -349,6 +365,7 @@ def load_plan(path: str, mmap: bool = True) -> HybridPlan:
             levels=levels, tail_sb=z["tail_sb"], tail_lane=z["tail_lane"],
             tail_row_ptr=z["tail_row_ptr"],
             out_degrees=z["out_degrees"], in_degrees=z["in_degrees"],
+            cap=127,   # legacy .npz plans predate the nibble cap
         )
 
 
@@ -534,21 +551,51 @@ def strip_boundaries(rows: np.ndarray, nchunks: int, chunk: int, nrb: int,
 # ---------------------------------------------------------------------------
 
 
+def resolve_pack(pack, plan_cap: int):
+    """One shared gate for the nibble-packing decision: explicit ``pack``
+    wins, else the LUX_PACK_STRIPS env opt-in; packing also requires the
+    plan's count cap to fit a nibble. Per-level, r must be even
+    (checked at the call sites via ``r % 2 == 0``)."""
+    if pack is None:
+        import os
+
+        pack = bool(int(os.environ.get("LUX_PACK_STRIPS", "0")))
+    return bool(pack) and plan_cap <= 15
+
+
+def pack_strips(strips: np.ndarray) -> np.ndarray:
+    """(..., r, 128) int8 counts <= 15 → (..., r/2, 128) uint8 nibbles.
+
+    Row j rides the low nibble, row j + r/2 the high nibble, so the
+    device-side unpack is one `& 15`, one `>> 4`, and a lane-axis concat
+    that lands in LOGICAL row order — no permutation anywhere. Halves
+    the per-iteration strip HBM traffic (the dominant strip-phase byte
+    stream); native int4 arrays would do the same but device_put of
+    int4 crashes the axon backend (RecursionError, jax 0.8)."""
+    r = strips.shape[-2]
+    assert r % 2 == 0, "nibble packing needs an even strip height"
+    lo = strips[..., : r // 2, :].astype(np.uint8)
+    hi = strips[..., r // 2 :, :].astype(np.uint8)
+    return lo | (hi << 4)
+
+
 @dataclasses.dataclass
 class DeviceLevel:
     """One strip level on device, chunked for lax.scan (pad strips are
     zero-count → contribute nothing). Boundary fields are the static
-    Z-stream data from :func:`strip_boundaries`."""
+    Z-stream data from :func:`strip_boundaries`. ``packed`` marks
+    nibble-packed strips ((C, r/2, 128) uint8, see pack_strips)."""
 
     r: int
     segs: tuple             # static gather-table segmentation
-    strips: jnp.ndarray     # (nchunks, C, r, 128) int8
+    strips: jnp.ndarray     # (nchunks, C, r, 128) int8 or packed uint8
     cols: jnp.ndarray       # (nchunks, C) int32
     bnd_row: jnp.ndarray    # (nrb+1,) int32
     bnd_grp: jnp.ndarray    # (nrb+1,) int32
     xing_idx: jnp.ndarray   # (|X|*r,) int32 flat output positions
     xing_s0: jnp.ndarray    # (|X|,) int32
     xing_s1: jnp.ndarray    # (|X|,) int32
+    packed: bool = False
 
 
 @dataclasses.dataclass
@@ -570,9 +617,18 @@ class DeviceHybrid:
         chunk_strips: int = DEFAULT_CHUNK_STRIPS,
         chunk_tail: int = DEFAULT_CHUNK_TAIL,
         device=None,
+        pack=None,
     ) -> "DeviceHybrid":
+        """``pack=True`` nibble-packs even-r levels (needs plan.cap <= 15;
+        default: the LUX_PACK_STRIPS env knob via :func:`resolve_pack`).
+        MEASURED NEGATIVE on v5e (PERF.md round 2): the strip scan is
+        VPU-bound, so halving its bytes buys nothing and the unpack adds
+        ~60% per-strip time (4.9 → 7.9 ns isolated, 114 → 139 ms/iter
+        end-to-end on RMAT22). Kept as an opt-in for hardware where the
+        balance differs."""
         put = lambda x: jax.device_put(jnp.asarray(x), device)
 
+        packed = resolve_pack(pack, plan.cap)
         dlevels = []
         for lev in plan.levels:
             nrb = plan.nvb * (BLOCK // lev.r)
@@ -589,10 +645,15 @@ class DeviceHybrid:
             row, grp, xi, s0, s1, segs = strip_boundaries(
                 lev.rows, k, c, nrb, lev.r
             )
+            lev_packed = packed and lev.r % 2 == 0
+            rr = lev.r // 2 if lev_packed else lev.r
+            if lev_packed:
+                st = pack_strips(st)
             dlevels.append(DeviceLevel(
                 r=lev.r,
                 segs=segs,
-                strips=put(st.reshape(k, c, lev.r, BLOCK)),
+                packed=lev_packed,
+                strips=put(st.reshape(k, c, rr, BLOCK)),
                 cols=put(co.reshape(k, c)),
                 bnd_row=put(row),
                 bnd_grp=put(grp),
@@ -717,6 +778,18 @@ def strip_level_spmv(x2d: jnp.ndarray, lev: DeviceLevel, nrb: int) -> jnp.ndarra
     def contrib_of(chunk):
         strips, cols = chunk
         xb = x2d[cols]                                  # (C, 128) row gather
+        if lev.packed:
+            # Nibble unpack: rows 0..r/2-1 in the low nibble, r/2..r-1
+            # in the high — the concat lands in logical row order.
+            lo = (strips & jnp.uint8(15)).astype(jnp.float32)
+            hi = (strips >> jnp.uint8(4)).astype(jnp.float32)
+            return jnp.concatenate(
+                [
+                    (lo * xb[:, None, :]).sum(-1),
+                    (hi * xb[:, None, :]).sum(-1),
+                ],
+                axis=-1,
+            )
         return (strips.astype(jnp.float32) * xb[:, None, :]).sum(-1)
 
     if r == BLOCK:
@@ -845,7 +918,7 @@ for _cls, _data, _meta in (
     (DeviceLevel,
      ["strips", "cols", "bnd_row", "bnd_grp",
       "xing_idx", "xing_s0", "xing_s1"],
-     ["r", "segs"]),
+     ["r", "segs", "packed"]),
     (DeviceHybrid,
      ["levels", "tail_sb", "tail_lane", "tail_bnd_row", "tail_bnd_grp",
       "tail_xing_idx", "tail_xing_s0", "tail_xing_s1"],

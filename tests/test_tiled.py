@@ -48,17 +48,59 @@ def test_plan_is_exact_partition(levels):
     assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
 
 
-def test_plan_spills_int8_overflow_exactly():
-    # 300 parallel edges in one cell: count clips at 127, the other 173
-    # must reappear in the tail.
+def test_plan_spills_count_overflow_exactly():
+    # 300 parallel edges in one cell: count clips at the nibble cap (15),
+    # the other 285 must reappear in the tail; with the legacy cap (127)
+    # the clip point moves but the edge multiset is still exact.
     src = np.concatenate([np.full(300, 2), [0, 1, 3]])
     dst = np.concatenate([np.full(300, 5), [4, 4, 4]])
     g = Graph.from_edges(src, dst, nv=8)
-    plan = plan_hybrid(g, levels=((8, 1),))
-    s_int = plan.rank[g.col_src]
-    d_int = plan.rank[g.col_dst]
-    assert max(lev.strips.max() for lev in plan.levels) == 127
-    assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
+    for cap in (15, 127):
+        plan = plan_hybrid(g, levels=((8, 1),), cap=cap)
+        s_int = plan.rank[g.col_src]
+        d_int = plan.rank[g.col_dst]
+        assert max(lev.strips.max() for lev in plan.levels) == cap
+        assert plan_edge_multiset(plan) == edge_multiset(s_int, d_int)
+
+
+def test_packed_strips_roundtrip_and_parity():
+    # Nibble packing must be lossless and the packed executor must match
+    # the plain engine bit-for-tolerance.
+    from lux_tpu.ops.tiled_spmv import pack_strips
+
+    rng = np.random.default_rng(0)
+    st = rng.integers(0, 16, (5, 8, 128)).astype(np.int8)
+    pk = pack_strips(st)
+    assert pk.shape == (5, 4, 128) and pk.dtype == np.uint8
+    np.testing.assert_array_equal(pk & 15, st[:, :4, :].astype(np.uint8))
+    np.testing.assert_array_equal(pk >> 4, st[:, 4:, :].astype(np.uint8))
+
+    from lux_tpu.engine.pull import PullExecutor
+
+    g = generate.rmat(10, 16, seed=4)
+    tex = TiledPullExecutor(
+        g, PageRank(), levels=((8, 1),), chunk_tail=64, pack=True
+    )
+    assert any(l.packed for l in tex.dhybrid.levels)
+    pex = PullExecutor(g, PageRank())
+    np.testing.assert_allclose(
+        np.asarray(tex.run(4)), np.asarray(pex.run(4)),
+        rtol=5e-5, atol=1e-9,
+    )
+
+
+def test_sharded_packed_parity():
+    from lux_tpu.engine.tiled_sharded import ShardedTiledExecutor
+    from lux_tpu.parallel.mesh import make_mesh
+
+    g = generate.rmat(10, 8, seed=6)
+    ex = ShardedTiledExecutor(
+        g, PageRank(), mesh=make_mesh(4), levels=((8, 1),),
+        chunk_strips=16, chunk_tail=64, pack=True,
+    )
+    got = np.asarray(ex.gather_values(ex.run(5)))
+    want = reference_pagerank(g, 5)
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-9)
 
 
 def test_plan_rejects_unpackable_strip_heights():
@@ -70,8 +112,13 @@ def test_plan_rejects_unpackable_strip_heights():
 
 def test_plan_respects_budget_and_density_floor():
     g = generate.rmat(9, 8, seed=3)
-    plan = plan_hybrid(g, levels=((8, 1),), budget_bytes=4 * 8 * BLOCK)
+    # budget_bytes counts DEVICE bytes: packed strips cost r*128/2 each.
+    plan = plan_hybrid(g, levels=((8, 1),), budget_bytes=4 * 8 * BLOCK // 2)
     assert plan.num_strips <= 4
+    legacy = plan_hybrid(
+        g, levels=((8, 1),), budget_bytes=4 * 8 * BLOCK, cap=127
+    )
+    assert legacy.num_strips <= 4
     plan2 = plan_hybrid(g, levels=((8, 10**9),))
     assert plan2.num_strips == 0
     assert plan2.tail_sb.shape[0] == g.ne
@@ -165,7 +212,7 @@ def test_plan_legacy_npz_load(tmp_path):
     back = load_plan(legacy)
     assert plan_edge_multiset(back) == plan_edge_multiset(plan)
     served = get_cached_plan(
-        g, str(tmp_path / "plan.luxplan"), levels=((8, 2),)
+        g, str(tmp_path / "plan.luxplan"), levels=((8, 2),), cap=127
     )
     np.testing.assert_array_equal(served.order, plan.order)
     np.testing.assert_array_equal(served.tail_sb, plan.tail_sb)

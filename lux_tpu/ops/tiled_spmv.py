@@ -163,10 +163,10 @@ def plan_hybrid(
     ``levels`` is a sequence of ``(r, min_count)`` pairs, consumed in
     order: each level takes the strips (at granularity r x 128) holding
     at least ``min_count`` still-unassigned edges, densest first, within
-    what remains of ``budget_bytes``. Cells holding more than ``cap``
-    parallel edges spill the excess to the tail; cap <= 15 halves the
-    device strip bytes via nibble packing (``budget_bytes`` counts
-    device bytes, so packing doubles how many strips fit).
+    what remains of ``budget_bytes`` (booked as unpacked int8 bytes).
+    Cells holding more than ``cap`` parallel edges spill the excess to
+    the tail; cap <= 15 keeps every even-r level nibble-packable at
+    device-build time (opt-in, see DeviceHybrid.build).
     """
     nv = graph.nv
     nvb = (nv + BLOCK - 1) // BLOCK

@@ -1,20 +1,30 @@
 #!/usr/bin/env python3
-"""Headline benchmark: PageRank GTEPS on an R-MAT graph, one TPU chip.
+"""Headline benchmark + suite. Prints ONE JSON line.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Headline: PageRank GTEPS on R-MAT scale-22, one TPU chip (the
+adversarial Kronecker-uniform workload — see PERF.md's hardware-floor
+analysis). The ``suite`` key carries single-chip stand-ins for the
+remaining BASELINE.json configs (the reference's graphs are not
+downloadable here — BASELINE.md):
 
-Baseline derivation: the reference repo publishes no numbers
-(BASELINE.md); its VLDB'17 paper's 8-GPU Twitter-2010 PageRank throughput
-is on the order of 10 GTEPS. BASELINE.json's north star is ">=1x the
-8xV100 GTEPS on Twitter-2010 PageRank on v5e-8"; this bench runs on ONE
-v5e chip, so we report vs_baseline against BASELINE_GTEPS / 8 (the per-GPU
-share), keeping the number honest for single-chip hardware.
+- pagerank_smallworld22: locality-rich stand-in for the web/social
+  configs (Hollywood/Indochina; real graphs cluster, R-MAT's tail does
+  not) — same nv/ne as the headline graph.
+- sssp_rmat22: the push engine to fixpoint (config 3's shape).
+- cf_bipartite: NetFlix-shaped weighted bipartite SGD (config 4),
+  exercising the edge-chunked engine (flat contributions exceed HBM).
 
-Knobs (env): LUX_BENCH_SCALE (default 22 → 4.19M vertices, 67.1M edges),
-LUX_BENCH_EF (16), LUX_BENCH_ITERS (50), LUX_BENCH_CACHE (.bench_cache),
-LUX_BENCH_LAYOUT (tiled|flat), LUX_BENCH_LEVELS (e.g. "8/4" or
-"32/8,8/3,2/2"), LUX_BENCH_TILE_MB (strip budget). Hybrid plans are
-cached next to the graph (planning is minutes of host np.unique time).
+Baseline derivation: the reference publishes no numbers (BASELINE.md);
+its VLDB'17 paper's 8-GPU Twitter-2010 PageRank throughput is on the
+order of 10 GTEPS. BASELINE.json's north star is ">=1x the 8xV100
+GTEPS on Twitter-2010 PageRank on v5e-8"; this bench runs on ONE v5e
+chip, so vs_baseline compares against BASELINE_GTEPS / 8 (the per-GPU
+share; see BASELINE.md for the sensitivity discussion).
+
+Knobs (env): LUX_BENCH_SCALE (22), LUX_BENCH_EF (16), LUX_BENCH_ITERS
+(50), LUX_BENCH_CACHE (.bench_cache), LUX_BENCH_LAYOUT (tiled|flat),
+LUX_BENCH_LEVELS ("8/2"), LUX_BENCH_TILE_MB (8192), LUX_BENCH_SUITE
+(1; 0 = headline only).
 """
 
 from __future__ import annotations
@@ -28,81 +38,76 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_GTEPS = 10.0      # assumed 8xV100 Twitter-2010 PageRank (see above)
 PER_CHIP_BASELINE = BASELINE_GTEPS / 8.0
+HBM_PEAK_GBPS = 819.0      # v5e HBM2E spec
 
 
-def get_graph(scale: int, ef: int, cache_dir: str):
-    from lux_tpu.graph import generate, read_lux, write_lux
+def log(msg: str):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def cached_graph(cache_dir: str, name: str, build):
+    from lux_tpu.graph import read_lux, write_lux
 
     os.makedirs(cache_dir, exist_ok=True)
-    path = os.path.join(cache_dir, f"rmat{scale}_{ef}.lux")
+    path = os.path.join(cache_dir, name + ".lux")
     if os.path.exists(path):
         t0 = time.time()
         g = read_lux(path)
-        print(f"# loaded cached {path} in {time.time()-t0:.1f}s", file=sys.stderr)
+        log(f"loaded cached {path} in {time.time()-t0:.1f}s")
         return g
     t0 = time.time()
-    g = generate.rmat(scale, ef, seed=42)
-    print(f"# generated rmat{scale} in {time.time()-t0:.1f}s", file=sys.stderr)
+    g = build()
+    log(f"generated {name} in {time.time()-t0:.1f}s")
     write_lux(path, g)
     return g
 
 
-def main():
-    scale = int(os.environ.get("LUX_BENCH_SCALE", "22"))
-    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
-    iters = int(os.environ.get("LUX_BENCH_ITERS", "50"))
-    cache = os.environ.get("LUX_BENCH_CACHE",
-                           os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                        ".bench_cache"))
+def tiled_bytes_per_iter(plan, nv: int) -> int:
+    """Primary per-iteration HBM byte streams of the tiled executor."""
+    tail_edges = plan.tail_sb.shape[0]
+    nrb_rows = sum(plan.nvb * (128 // lev.r) for lev in plan.levels)
+    return (
+        plan.strip_bytes                      # int8 strip reads
+        + plan.num_strips * 512               # x-block row gather per strip
+        + tail_edges * (512 + 5)              # tail row gather + sb/lane
+        + (nv + 1 + nrb_rows) * 2 * 512       # boundary extraction gathers
+        + 4 * nv * 4                          # apply + output passes
+    )
 
-    from lux_tpu.utils.platform import ensure_backend
 
-    platform = ensure_backend()
-    print(f"# platform: {platform}", file=sys.stderr)
-
-    g = get_graph(scale, ef, cache)
+def bench_pagerank(g, cache: str, tag: str, iters: int, layout: str,
+                   levels, budget: int):
     from lux_tpu.engine.pull import PullExecutor, hard_sync
     from lux_tpu.models import PageRank
 
-    layout = os.environ.get("LUX_BENCH_LAYOUT", "tiled")
-    if layout not in ("tiled", "flat"):
-        raise SystemExit(f"LUX_BENCH_LAYOUT must be 'tiled' or 'flat', got {layout!r}")
     if layout == "tiled":
         from lux_tpu.engine.tiled import TiledPullExecutor, get_cached_plan
 
-        budget = int(os.environ.get("LUX_BENCH_TILE_MB", "8192")) << 20
-        levels = tuple(
-            tuple(int(v) for v in part.split("/"))
-            for part in os.environ.get("LUX_BENCH_LEVELS", "8/2").split(",")
-        )
         lev_tag = "_".join(f"{r}x{t}" for r, t in levels)
         plan_path = os.path.join(
-            cache, f"plan_rmat{scale}_{ef}_{lev_tag}_{budget >> 20}.luxplan"
+            cache, f"plan_{tag}_{lev_tag}_{budget >> 20}.luxplan"
         )
         t0 = time.time()
         plan = get_cached_plan(
-            g, plan_path, levels=levels, budget_bytes=budget,
-            log=lambda m: print(f"# {m}", file=sys.stderr),
+            g, plan_path, levels=levels, budget_bytes=budget, log=log
         )
-        print(f"# plan ready ({lev_tag}) in {time.time()-t0:.1f}s",
-              file=sys.stderr)
+        log(f"plan ready ({lev_tag}) in {time.time()-t0:.1f}s")
         ex = TiledPullExecutor(g, PageRank(), plan=plan)
-        print(
-            f"# hybrid plan: {ex.plan.num_strips} strips "
-            f"({ex.plan.strip_bytes/1e9:.2f} GB), "
-            f"coverage={ex.plan.coverage:.1%}",
-            file=sys.stderr,
+        log(
+            f"{tag} hybrid plan: {plan.num_strips} strips "
+            f"({plan.strip_bytes/1e9:.2f} GB), coverage={plan.coverage:.1%}"
         )
+        bytes_iter = tiled_bytes_per_iter(plan, g.nv)
     else:
         ex = PullExecutor(g, PageRank())
+        bytes_iter = g.ne * (512 + 8) + 4 * g.nv * 4
     ex.warmup()
 
     # Timed: `iters` iterations, async-pipelined, one hard sync at the end
     # (the reference's measurement discipline, pagerank.cc:106-118;
     # hard_sync because block_until_ready returns early on tunneled
     # backends and would fake a ~1000x speedup). The second settle run
-    # goes through the vals= path so every jitted helper (including the
-    # tiled executor's permutation converters) compiles before t0.
+    # goes through the vals= path so every jitted helper compiles first.
     vals = hard_sync(ex.run(1, flush_every=0))
     vals = hard_sync(ex.run(1, vals=vals, flush_every=0))
     t0 = time.perf_counter()
@@ -110,48 +115,136 @@ def main():
     elapsed = time.perf_counter() - t0
 
     gteps = g.ne * iters / elapsed / 1e9
-    print(
-        f"# nv={g.nv} ne={g.ne} iters={iters} elapsed={elapsed:.4f}s "
-        f"({elapsed/iters*1e3:.2f} ms/iter)",
-        file=sys.stderr,
+    gbps = bytes_iter * iters / elapsed / 1e9
+    log(
+        f"{tag}: nv={g.nv} ne={g.ne} iters={iters} elapsed={elapsed:.4f}s "
+        f"({elapsed/iters*1e3:.2f} ms/iter, {gteps:.3f} GTEPS, "
+        f"{gbps:.0f} GB/s)"
+    )
+    return {
+        "gteps": round(gteps, 4),
+        "ms_per_iter": round(elapsed / iters * 1e3, 2),
+        "achieved_gbps": round(gbps, 1),
+        "hbm_peak_frac": round(gbps / HBM_PEAK_GBPS, 3),
+    }
+
+
+def bench_sssp(g, max_iters: int = 12):
+    from lux_tpu.engine.push import PushExecutor
+    from lux_tpu.models.sssp import SSSP
+
+    ex = PushExecutor(g, SSSP())
+    ex.warmup(start=0)
+    t0 = time.perf_counter()
+    state, iters = ex.run(max_iters=max_iters, start=0)
+    elapsed = time.perf_counter() - t0
+    gteps = g.ne * iters / elapsed / 1e9
+    log(
+        f"sssp: {iters} iters ({ex.sparse_iters} sparse) in "
+        f"{elapsed:.2f}s ({gteps:.3f} GTEPS)"
+    )
+    return {
+        "gteps": round(gteps, 4),
+        "iters": iters,
+        "sparse_iters": ex.sparse_iters,
+        "ms_per_iter": round(elapsed / max(iters, 1) * 1e3, 2),
+    }
+
+
+def bench_cf(g, iters: int = 5):
+    from lux_tpu.engine.pull import PullExecutor, hard_sync
+    from lux_tpu.models.colfilter import CollaborativeFiltering
+
+    ex = PullExecutor(g, CollaborativeFiltering())
+    log(f"cf: edge_chunk={ex.edge_chunk}")
+    ex.warmup()
+    vals = hard_sync(ex.run(1, flush_every=0))
+    t0 = time.perf_counter()
+    vals = ex.run(iters, vals=vals, flush_every=0)
+    elapsed = time.perf_counter() - t0
+    gteps = g.ne * iters / elapsed / 1e9
+    log(
+        f"cf: nv={g.nv} ne={g.ne} {iters} iters, "
+        f"{elapsed/iters*1e3:.1f} ms/iter ({gteps:.3f} GTEPS)"
+    )
+    return {
+        "gteps": round(gteps, 4),
+        "ms_per_iter": round(elapsed / iters * 1e3, 2),
+        "edge_chunked": bool(ex.edge_chunk),
+    }
+
+
+def main():
+    scale = int(os.environ.get("LUX_BENCH_SCALE", "22"))
+    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
+    iters = int(os.environ.get("LUX_BENCH_ITERS", "50"))
+    cache = os.environ.get(
+        "LUX_BENCH_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_cache"),
+    )
+    layout = os.environ.get("LUX_BENCH_LAYOUT", "tiled")
+    if layout not in ("tiled", "flat"):
+        raise SystemExit(f"LUX_BENCH_LAYOUT must be tiled|flat, got {layout!r}")
+    budget = int(os.environ.get("LUX_BENCH_TILE_MB", "8192")) << 20
+    levels = tuple(
+        tuple(int(v) for v in part.split("/"))
+        for part in os.environ.get("LUX_BENCH_LEVELS", "8/2").split(",")
+    )
+    run_suite = os.environ.get("LUX_BENCH_SUITE", "1") != "0"
+
+    from lux_tpu.utils.platform import ensure_backend
+
+    log(f"platform: {ensure_backend()}")
+
+    from lux_tpu.graph import generate
+
+    g = cached_graph(
+        cache, f"rmat{scale}_{ef}",
+        lambda: generate.rmat(scale, ef, seed=42),
+    )
+    head = bench_pagerank(
+        g, cache, f"rmat{scale}_{ef}", iters, layout, levels, budget
     )
 
-    # Achieved HBM bandwidth: primary per-iteration byte streams of the
-    # executor (strip arrays + per-strip x-row gathers + per-tail-edge
-    # row gather and metadata + boundary-extraction gathers + the apply
-    # pass), against the v5e spec peak. Attributes regressions: a GTEPS
-    # drop with flat GB/s means added bytes; with dropping GB/s, lost
-    # pipeline efficiency.
-    HBM_PEAK_GBPS = 819.0  # v5e HBM2E spec
-    if layout == "tiled":
-        p = ex.plan
-        tail_edges = p.tail_sb.shape[0]
-        nrb_rows = sum(
-            p.nvb * (128 // lev.r) for lev in p.levels
+    out = {
+        "metric": f"pagerank_rmat{scale}_gteps_1chip",
+        "value": head["gteps"],
+        "unit": "GTEPS",
+        "vs_baseline": round(head["gteps"] / PER_CHIP_BASELINE, 4),
+        "layout": layout,
+        "achieved_gbps": head["achieved_gbps"],
+        "hbm_peak_frac": head["hbm_peak_frac"],
+    }
+
+    if run_suite:
+        suite = {}
+        nv_sw = 1 << scale
+        g_sw = cached_graph(
+            cache, f"smallworld{scale}_{ef}",
+            lambda: generate.small_world(nv_sw, k=ef, p_rewire=0.05, seed=7),
         )
-        bytes_iter = (
-            p.strip_bytes                     # int8 strip reads
-            + p.num_strips * 512              # x-block row gather per strip
-            + tail_edges * (512 + 5)          # tail row gather + sb/lane
-            + (g.nv + 1 + nrb_rows) * 2 * 512  # boundary extraction gathers
-            + 4 * g.nv * 4                    # apply + output passes
+        suite["pagerank_smallworld"] = bench_pagerank(
+            g_sw, cache, f"smallworld{scale}_{ef}", iters, layout, levels,
+            budget,
         )
-    else:
-        bytes_iter = g.ne * (512 + 8) + 4 * g.nv * 4
-    gbps = bytes_iter * iters / elapsed / 1e9
-    print(
-        json.dumps(
-            {
-                "metric": f"pagerank_rmat{scale}_gteps_1chip",
-                "value": round(gteps, 4),
-                "unit": "GTEPS",
-                "vs_baseline": round(gteps / PER_CHIP_BASELINE, 4),
-                "layout": layout,
-                "achieved_gbps": round(gbps, 1),
-                "hbm_peak_frac": round(gbps / HBM_PEAK_GBPS, 3),
-            }
+        suite["sssp_rmat"] = bench_sssp(g)
+        # NetFlix-shaped at the default scale (480K users x 17.8K items x
+        # 50M ratings x 2 directions = 100M edges); shrinks with
+        # LUX_BENCH_SCALE so smoke runs stay quick.
+        n_users = min(480_000, 1 << max(scale - 3, 1))
+        n_items = max(n_users // 27, 64)
+        n_ratings = 12 << scale
+        g_cf = cached_graph(
+            cache, f"cf_netflix_like_{scale}",
+            lambda: generate.bipartite_ratings(
+                n_users, n_items, n_ratings, seed=11
+            ),
         )
-    )
+        suite["cf_bipartite"] = bench_cf(g_cf)
+        out["suite"] = suite
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

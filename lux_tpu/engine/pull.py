@@ -218,6 +218,18 @@ class PullExecutor:
                 f"({program.name} has {program.combiner!r})"
             )
 
+        # Lane padding for K-vector values on the chunked path: a gather
+        # of (C, 20)-wide rows scalarizes on TPU (~765 ns/edge measured
+        # on the NetFlix-shaped CF bench) because 20 < the 128-lane tile;
+        # padding values to (nv, 128) makes every gather a full-bandwidth
+        # 512 B row fetch and the chunk cumsum full-lane. Pad lanes are
+        # re-zeroed after apply so programs whose apply adds constants
+        # cannot leak garbage into the next iteration's contractions.
+        self._kreal = width if vshape else 0
+        self._kpad = (-(-width // 128)) * 128 if (
+            self.edge_chunk and len(vshape) == 1 and width % 128
+        ) else 0
+
         if self.edge_chunk:
             C = self.edge_chunk
             nchunks, bnd_pos, gidx, bchunk = _chunk_boundary_plan(
@@ -291,12 +303,17 @@ class PullExecutor:
         chunk-local mass, not stream mass). Pad edges land after the last
         real boundary, so their garbage contributions are never gathered,
         and the polluted final chunk total is never used (the exclusive
-        prefix stops before it)."""
+        prefix stops before it).
+
+        When lane padding is active (``self._kpad``), ``vals`` arrives
+        and leaves (nv, kpad) — the fused runner keeps it padded across
+        iterations; run()/step() convert at the boundary."""
         from lux_tpu.ops.tiled_spmv import _dd_prefix
 
         prog = self.program
         vshape = tuple(getattr(prog, "value_shape", ()) or ())
-        k = int(np.prod(vshape)) if vshape else 1
+        kreal = int(np.prod(vshape)) if vshape else 1
+        k = self._kpad or kreal
 
         def body(_, ch):
             cs, cd, w, bnd = ch
@@ -327,13 +344,19 @@ class PullExecutor:
             + (ph[ci[1:]] - ph[ci[:-1]])
             + (pl[ci[1:]] - pl[ci[:-1]])
         )
-        acc = acc.reshape((self.graph.nv,) + vshape)
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=dg.out_degrees,
             in_degrees=dg.in_degrees,
         )
-        return prog.apply(vals, acc, ctx)
+        if not self._kpad:
+            acc = acc.reshape((self.graph.nv,) + vshape)
+            return prog.apply(vals, acc, ctx)
+        new = prog.apply(vals, acc, ctx)
+        # Re-zero pad lanes: apply may write constants into them, which
+        # would otherwise pollute the next iteration's contractions.
+        lane = jnp.arange(k, dtype=jnp.int32)
+        return jnp.where(lane[None, :] < kreal, new, 0)
 
     # -- driver ----------------------------------------------------------
 
@@ -342,7 +365,17 @@ class PullExecutor:
             jnp.asarray(self.program.init_values(self.graph)), self.device
         )
 
+    def _lane_pad(self, vals: jnp.ndarray) -> jnp.ndarray:
+        return jnp.pad(vals, ((0, 0), (0, self._kpad - self._kreal)))
+
     def step(self, vals: jnp.ndarray) -> jnp.ndarray:
+        """One iteration; external (nv, *value_shape) in and out (the
+        lane-padded internal layout is private to the jitted step)."""
+        if self._kpad:
+            padded = self._step(
+                self._lane_pad(jnp.asarray(vals)), self.dgraph
+            )
+            return padded[:, : self._kreal]
         return self._step(vals, self.dgraph)
 
     def warmup(self):
@@ -360,6 +393,14 @@ class PullExecutor:
     ):
         if vals is None:
             vals = self.init_values()
+        if self._kpad:
+            padded = run_maybe_fused(
+                self._jrun,
+                lambda v: self._step(v, self.dgraph),
+                self._lane_pad(jnp.asarray(vals)),
+                num_iters, flush_every, self.dgraph,
+            )
+            return hard_sync(padded[:, : self._kreal])
         return run_maybe_fused(
             self._jrun, self.step, vals, num_iters, flush_every, self.dgraph
         )

@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Host-only vertex-ordering experiments scored by modeled iteration time.
+
+Model (ns, calibrated on round-1 v5e phase measurements at (8,2)):
+    t = 4.9*strips + 2.55*tail_edges + 6*strip_rows + 3*nv + fixed
+Round-1 measured 115 ms/iter; model gives 119.7 — good enough to rank
+orderings without a TPU in the loop.
+"""
+import sys, os, time
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lux_tpu.graph import read_lux
+
+BLOCK = 128
+
+
+def coverage(s, d, nv, r=8, thr=2):
+    nvb = (nv + 127) // 128
+    sid = (d // r).astype(np.int64) * nvb + (s >> 7)
+    us, cs = np.unique(sid, return_counts=True)
+    m = cs >= thr
+    strips = int(m.sum())
+    cov_edges = int(cs[m].sum())
+    ne = len(s)
+    tail = ne - cov_edges
+    t_model = (4.9 * strips + 2.55 * tail + 6 * (nv // r) + 3 * nv) / 1e6
+    return cov_edges / ne, strips, tail, t_model
+
+
+def score(name, rank, g):
+    s = rank[g.col_src]
+    d = rank[g.col_dst]
+    cov, strips, tail, t = coverage(s, d, g.nv)
+    print(f"{name:34s} cov={cov:6.1%} strips={strips/1e6:5.2f}M "
+          f"tail={tail/1e6:5.1f}M  t_model={t:6.1f} ms", flush=True)
+    return t
+
+
+def main():
+    g = read_lux(sys.argv[1] if len(sys.argv) > 1 else
+                 ".bench_cache/rmat22_16.lux")
+    nv = g.nv
+    deg = g.in_degrees + g.out_degrees
+
+    # baseline: degree sort
+    order0 = np.argsort(-deg, kind="stable").astype(np.int32)
+    rank0 = np.empty(nv, np.int32); rank0[order0] = np.arange(nv, dtype=np.int32)
+    score("degree (baseline)", rank0, g)
+
+    # --- dominant-dst-row clustering on top of degree sort -------------
+    # Hubs (top block of the degree order) keep their slots; every other
+    # source is keyed by the smallest dst-row (in degree order) it points
+    # at, so single-edge sources aiming at the same row share a block.
+    s0 = rank0[g.col_src]; d0 = rank0[g.col_dst]
+    for r in (8,):
+        for hub_frac in (0.02, 0.05, 0.10, 0.25):
+            nhub = int(nv * hub_frac)
+            t0 = time.time()
+            drow = d0 // r
+            # min dst-row per src (sources with no out-edges get a big key)
+            key = np.full(nv, np.int64(nv), np.int64)
+            np.minimum.at(key, s0, drow)
+            is_hub = rank0 < nhub  # internal position < nhub
+            # order: hubs first (by degree), then others by (min-row, deg)
+            rest = np.arange(nv, dtype=np.int64)[~is_hub[np.arange(nv)]]
+            # sort rest by (key, rank0) — pack into one int64 for radix
+            packed = key[rest] * nv + rank0[rest]
+            rest = rest[np.argsort(packed, kind="stable")]
+            hubs = order0[:nhub]
+            order1 = np.concatenate([hubs, rest.astype(np.int32)])
+            rank1 = np.empty(nv, np.int32)
+            rank1[order1] = np.arange(nv, dtype=np.int32)
+            score(f"minrow r={r} hubs={hub_frac:.0%} "
+                  f"({time.time()-t0:.0f}s)", rank1, g)
+
+    # --- iterate: recompute min-row under the improved order -----------
+    # (best hub_frac from above pass, one refinement round)
+    nhub = int(nv * 0.05)
+    rank = rank0
+    for it in range(3):
+        sL = rank[g.col_src]; dL = rank[g.col_dst]
+        drow = dL // 8
+        key = np.full(nv, np.int64(nv), np.int64)
+        np.minimum.at(key, sL, drow)
+        is_hub_pos = rank < nhub
+        rest = np.arange(nv, dtype=np.int64)[~is_hub_pos]
+        packed = key[rest] * nv + rank[rest]
+        rest = rest[np.argsort(packed, kind="stable")]
+        hubs = np.arange(nv, dtype=np.int32)[is_hub_pos][
+            np.argsort(rank[is_hub_pos], kind="stable")]
+        order = np.concatenate([hubs, rest.astype(np.int32)])
+        rank = np.empty(nv, np.int32)
+        rank[order] = np.arange(nv, dtype=np.int32)
+        score(f"minrow iter{it+1} hubs=5%", rank, g)
+
+
+if __name__ == "__main__":
+    main()

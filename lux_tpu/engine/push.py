@@ -58,6 +58,12 @@ class PushProgram:
     combiner: str = "min"          # 'min' | 'max'
     value_dtype = jnp.uint32
     needs_weights: bool = False
+    # Declare True iff every value the program can ever hold fits in 31
+    # bits (e.g. SSSP distances and CC labels, both <= nv < 2^31). The
+    # blocked dense path packs the frontier bit into the value's top bit
+    # and silently corrupts programs that use it — it only enables when
+    # this is declared.
+    packable_values: bool = False
 
     def init_values(self, graph: Graph, **kw) -> np.ndarray:
         raise NotImplementedError
@@ -89,6 +95,35 @@ def _sparse_budgets(nv: int, ne: int, queue_frac: int, edge_budget_frac: int):
     per-part sparse queue sizing (nv/SPARSE_THRESHOLD + slack,
     push_model.inl:390-412)."""
     return nv // queue_frac + 128, max(ne // edge_budget_frac, 1024)
+
+
+def _blocked_candidates(x2d, relax, combiner, chunks, weighted: bool):
+    """Shared scan body of the blocked dense path: per edge, one 128-lane
+    row gather from the packed (value | frontier<<31) uint32 table
+    ``x2d``, lane select, unpack, relax, identity-mask. ``chunks`` is
+    (sb, lane[, emask][, w]) with leading scan axes; returns the flat
+    candidate stream (padded length)."""
+    iota = jnp.arange(128, dtype=jnp.int32)
+    ident = identity_for(combiner, jnp.uint32)
+
+    def body(_, ch):
+        ch = list(ch)
+        sb, lane = ch[0], ch[1]
+        w = ch.pop() if weighted else None
+        em = ch[2] if len(ch) > 2 else None
+        rows = x2d[sb]
+        pk = jnp.where(
+            lane.astype(jnp.int32)[:, None] == iota[None, :], rows, 0
+        ).sum(axis=1, dtype=jnp.uint32)
+        sv = pk & jnp.uint32(0x7FFFFFFF)
+        active = (pk >> 31).astype(bool)
+        if em is not None:
+            active = active & em
+        cand = relax(sv, w)
+        return 0, jnp.where(active, cand, ident)
+
+    _, cands = jax.lax.scan(body, 0, tuple(chunks))
+    return cands.reshape(-1)
 
 
 def _queue_edge_slots(start, deg, E: int, ne_cap: int):
@@ -192,6 +227,7 @@ class PushExecutor:
         if blocked_dense is None:
             blocked_dense = (
                 graph.ne >= self.BLOCKED_DENSE_MIN_NE
+                and getattr(program, "packable_values", False)
                 and program.value_dtype == jnp.uint32
                 and graph.nv < 2**31
                 and graph.ne < 2**31   # end positions are int32
@@ -200,10 +236,13 @@ class PushExecutor:
             # An explicit request must not silently corrupt: the packed
             # table carries the frontier in the value's top bit and the
             # scan layout uses int32 positions.
-            if program.value_dtype != jnp.uint32:
+            if program.value_dtype != jnp.uint32 or not getattr(
+                program, "packable_values", False
+            ):
                 raise ValueError(
-                    "blocked_dense needs uint32 vertex values "
-                    f"({program.name} has {program.value_dtype})"
+                    "blocked_dense needs a program declaring "
+                    "packable_values (uint32 values < 2^31); "
+                    f"{program.name} does not"
                 )
             if graph.nv >= 2**31 or graph.ne >= 2**31:
                 raise ValueError(
@@ -313,30 +352,13 @@ class PushExecutor:
         x2d = jnp.pad(packed, (0, nvb * 128 - self.graph.nv)).reshape(
             nvb, 128
         )
-        iota = jnp.arange(128, dtype=jnp.int32)
-        ident = identity_for(prog.combiner, jnp.uint32)
         has_w = "blk_w" in dg
-
-        def body(_, ch):
-            if has_w:
-                sb, lane, w = ch
-            else:
-                (sb, lane), w = ch, None
-            rows = x2d[sb]                              # (C, 128) row gather
-            pk = jnp.where(
-                lane.astype(jnp.int32)[:, None] == iota[None, :], rows, 0
-            ).sum(axis=1, dtype=jnp.uint32)             # (C,)
-            sv = pk & jnp.uint32(0x7FFFFFFF)
-            active = (pk >> 31).astype(bool)
-            cand = prog.relax(sv, w)
-            return 0, jnp.where(active, cand, ident)
-
-        xs = (
-            (dg["blk_sb"], dg["blk_lane"], dg["blk_w"]) if has_w
-            else (dg["blk_sb"], dg["blk_lane"])
+        chunks = (dg["blk_sb"], dg["blk_lane"])
+        if has_w:
+            chunks = chunks + (dg["blk_w"],)
+        return _blocked_candidates(
+            x2d, prog.relax, prog.combiner, chunks, has_w
         )
-        _, cands = jax.lax.scan(body, 0, xs)
-        return cands.reshape(-1)
 
     def _bd_comp(self, cands, dg):
         from lux_tpu.ops.segment import segment_minmax_by_rowptr
@@ -602,6 +624,8 @@ class ShardedPushExecutor:
     frontier counts, psum of frontier out-edges) so every shard takes the
     same ``lax.cond`` side."""
 
+    BLOCKED_DENSE_MIN_NE = PushExecutor.BLOCKED_DENSE_MIN_NE
+
     def __init__(
         self,
         graph: Graph,
@@ -611,6 +635,7 @@ class ShardedPushExecutor:
         sparse: bool = True,
         queue_frac: int = 16,       # per-shard queue = max_nv/queue_frac + slack
         edge_budget_frac: int = 8,  # per-shard edge budget = max_ne/frac
+        blocked_dense: Optional[bool] = None,
     ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
@@ -621,13 +646,77 @@ class ShardedPushExecutor:
         self.sg = ShardedGraph.build(graph, self.num_parts)
         sh = parts_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), sh)
+
+        # Blocked dense path, distributed: same single-vs-multi-identical
+        # contract as the reference (core/push_model.inl) — each shard
+        # serves its edges from the all-gathered packed (value,
+        # frontier-bit) table via row gathers + lane select and reduces
+        # with the segmented min/max scan over its local CSC.
+        flat_nv = self.num_parts * self.sg.max_nv
+        if blocked_dense is None:
+            blocked_dense = (
+                graph.ne >= self.BLOCKED_DENSE_MIN_NE
+                and getattr(program, "packable_values", False)
+                and program.value_dtype == jnp.uint32
+                and flat_nv < 2**31
+                and self.sg.max_ne < 2**31
+            )
+        elif blocked_dense:
+            if program.value_dtype != jnp.uint32 or not getattr(
+                program, "packable_values", False
+            ):
+                raise ValueError(
+                    "blocked_dense needs a program declaring "
+                    "packable_values (uint32 values < 2^31); "
+                    f"{program.name} does not"
+                )
+            if flat_nv >= 2**31 or self.sg.max_ne >= 2**31:
+                raise ValueError(
+                    "blocked_dense needs P*max_nv and max_ne < 2^31 "
+                    f"(got {flat_nv}, {self.sg.max_ne})"
+                )
+        self.blocked_dense = bool(blocked_dense)
+
         self._dg = {
-            "src_pidx": put(self.sg.src_pidx),
-            "dst_local": put(self.sg.dst_local),
             "vertex_mask": put(self.sg.vertex_mask),
         }
-        if self.sg.weights is not None:
-            self._dg["weights"] = put(self.sg.weights)
+        if self.blocked_dense:
+            P_, max_ne = self.num_parts, self.sg.max_ne
+            C = 1 << 17
+            pad = (-max_ne) % C
+            k = (max_ne + pad) // C
+
+            def chunked(a, fill=0):
+                return np.pad(
+                    a, ((0, 0), (0, pad)), constant_values=fill
+                ).reshape(P_, k, C)
+
+            self._dg["blk_sb"] = put(
+                chunked(self.sg.src_pidx >> 7).astype(np.int32)
+            )
+            self._dg["blk_lane"] = put(
+                chunked(self.sg.src_pidx & 127).astype(np.int8)
+            )
+            self._dg["blk_emask"] = put(chunked(self.sg.edge_mask))
+            if self.sg.weights is not None:
+                self._dg["blk_w"] = put(chunked(self.sg.weights))
+            seg_start = np.zeros((P_, max_ne), bool)
+            end_pos = np.zeros((P_, self.sg.max_nv), np.int32)
+            nonempty = np.zeros((P_, self.sg.max_nv), bool)
+            for p in range(P_):
+                lrp = self.sg.local_row_ptr[p].astype(np.int64)
+                starts = lrp[:-1]
+                seg_start[p, starts[starts < max_ne]] = True
+                end_pos[p] = np.clip(lrp[1:] - 1, 0, max(max_ne - 1, 0))
+                nonempty[p] = np.diff(lrp) > 0
+            self._dg["seg_start"] = put(seg_start)
+            self._dg["end_pos"] = put(end_pos)
+            self._dg["row_nonempty"] = put(nonempty)
+        else:
+            self._dg["src_pidx"] = put(self.sg.src_pidx)
+            self._dg["dst_local"] = put(self.sg.dst_local)
+            if self.sg.weights is not None:
+                self._dg["weights"] = put(self.sg.weights)
         self.sparse = sparse and graph.ne >= 1024
         if self.sparse:
             self.queue_cap, self.edge_budget = _sparse_budgets(
@@ -661,19 +750,22 @@ class ShardedPushExecutor:
         max_nv = self.sg.max_nv
         v = state.values[0]
         f = state.frontier[0]
-        all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1)
-        all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1)
-        sidx = dg["src_pidx"][0]
-        src_vals = all_v[sidx]
-        src_front = all_f[sidx]
-        w = dg["weights"][0] if "weights" in dg else None
-        cand = prog.relax(src_vals, w)
-        ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(src_front, cand, ident)
-        acc = segment_reduce(
-            cand, dg["dst_local"][0], num_segments=max_nv + 1,
-            kind=prog.combiner,
-        )[:max_nv]
+        if self.blocked_dense:
+            acc = self._blocked_dense_acc(v, f, dg)
+        else:
+            all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1)
+            all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1)
+            sidx = dg["src_pidx"][0]
+            src_vals = all_v[sidx]
+            src_front = all_f[sidx]
+            w = dg["weights"][0] if "weights" in dg else None
+            cand = prog.relax(src_vals, w)
+            ident = identity_for(prog.combiner, cand.dtype)
+            cand = jnp.where(src_front, cand, ident)
+            acc = segment_reduce(
+                cand, dg["dst_local"][0], num_segments=max_nv + 1,
+                kind=prog.combiner,
+            )[:max_nv]
         if prog.combiner == "min":
             new = jnp.minimum(v, acc)
         else:
@@ -683,6 +775,29 @@ class ShardedPushExecutor:
         frontier = (new != v) & vmask
         cnt = frontier.sum(dtype=jnp.int32)
         return PushState(new[None], frontier[None]), cnt
+
+    def _blocked_dense_acc(self, v, f, dg):
+        """Per-local-destination reduction via the packed-table blocked
+        path: ONE all-gather of (value | frontier<<31) uint32 shards
+        (half the plain path's value+frontier exchange bytes), row-gather
+        + lane-select candidate generation, segmented min/max scan."""
+        from lux_tpu.ops.segment import segment_minmax_by_rowptr
+
+        prog = self.program
+        packed = v.astype(jnp.uint32) | (f.astype(jnp.uint32) << 31)
+        allp = jax.lax.all_gather(packed, PARTS_AXIS).reshape(-1)
+        x2d = jnp.pad(allp, (0, (-allp.shape[0]) % 128)).reshape(-1, 128)
+        has_w = "blk_w" in dg
+        chunks = (dg["blk_sb"][0], dg["blk_lane"][0], dg["blk_emask"][0])
+        if has_w:
+            chunks = chunks + (dg["blk_w"][0],)
+        cands = _blocked_candidates(
+            x2d, prog.relax, prog.combiner, chunks, has_w
+        )
+        return segment_minmax_by_rowptr(
+            cands[: self.sg.max_ne], dg["seg_start"][0],
+            dg["end_pos"][0], dg["row_nonempty"][0], prog.combiner,
+        )
 
     def _sparse_block(self, state: PushState, dg):
         """One sparse iteration: bounded local queue → all-gather of

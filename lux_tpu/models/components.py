@@ -21,6 +21,7 @@ class ConnectedComponents(PushProgram):
     name = "components"
     combiner = "max"
     value_dtype = jnp.uint32
+    packable_values = True     # labels < nv < 2^31
 
     def init_values(self, graph: Graph, **kw) -> np.ndarray:
         return np.arange(graph.nv, dtype=np.uint32)

@@ -152,6 +152,8 @@ def segmented_minmax_scan(
     # measured); per-chunk scans bound the compiled graph while the
     # runtime stays O(n).
     n = data.shape[0]
+    if n == 0:
+        return data
     chunk = min(1 << 17, max(n, 1))
     pad = (-n) % chunk
     ident = identity_for(kind, data.dtype)
@@ -168,7 +170,13 @@ def segmented_minmax_scan(
         out = jnp.where(lf, lv, pick(cv, lv))
         return out[-1], out
 
-    _, out = jax.lax.scan(body, jnp.asarray(ident, data.dtype), (d, f))
+    # Derive the identity carry FROM data (x*0 + ident) so that under
+    # shard_map it inherits data's varying-axes metadata — a replicated
+    # constant init trips the scan carry type check.
+    init = d[0, 0] * jnp.asarray(0, data.dtype) + jnp.asarray(
+        ident, data.dtype
+    )
+    _, out = jax.lax.scan(body, init, (d, f))
     return out.reshape(-1)[:n]
 
 

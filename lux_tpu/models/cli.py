@@ -39,8 +39,9 @@ def build_parser(name: str, push: bool) -> argparse.ArgumentParser:
     p.add_argument("-check", action="store_true")
     p.add_argument("-verbose", action="store_true")
     p.add_argument(
-        "-parts", type=int, default=1,
-        help="mesh devices to shard over (1 = single device)",
+        "-parts", "-ng", type=int, default=1, dest="parts",
+        help="mesh devices to shard over (1 = single device); -ng is the "
+        "reference's alias for its GPU count (pagerank.cc:127)",
     )
     p.add_argument(
         "-strategy", choices=["rowptr", "segment"], default="rowptr",

@@ -54,6 +54,18 @@ class ShardedPullExecutor:
         self.sum_strategy = sum_strategy
         self.sg = ShardedGraph.build(graph, self.num_parts)
 
+        # Lane padding for K-vector values: gathering (ne, K)-narrow rows
+        # scalarizes on TPU (measured 76.5 s/iter on NetFlix-shaped CF in
+        # the single-device engine before the same fix). Values are
+        # STORED lane-padded per shard so the src/dst row gathers stream
+        # full 512 B rows; the all-gather sends the UNPADDED slice (the
+        # pad is re-applied locally), so ICI bytes do not inflate.
+        from lux_tpu.engine.pull import lane_pad_width
+
+        self._kreal, self._kpad = lane_pad_width(
+            getattr(program, "value_shape", ())
+        )
+
         sh = parts_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), sh)
         sgd = {
@@ -83,9 +95,23 @@ class ShardedPullExecutor:
     def _shard_step(self, vals_blk, dg):
         prog = self.program
         max_nv = self.sg.max_nv
-        v = vals_blk[0]                                   # (max_nv, *t)
-        gathered = jax.lax.all_gather(v, PARTS_AXIS)      # (P, max_nv, *t)
-        flat = gathered.reshape((-1,) + v.shape[1:])
+        v = vals_blk[0]                  # (max_nv, *t); lane-padded if _kpad
+        kp, kr = self._kpad, self._kreal
+        if kp:
+            # Exchange the real lanes only; re-pad locally for fast
+            # 512 B-row gathers from the flat table.
+            gathered = jax.lax.all_gather(v[:, :kr], PARTS_AXIS)
+            flat = gathered.reshape(-1, kr)
+            flat = jnp.pad(flat, ((0, 0), (0, kp - kr)))
+        else:
+            gathered = jax.lax.all_gather(v, PARTS_AXIS)  # (P, max_nv, *t)
+            flat = gathered.reshape((-1,) + v.shape[1:])
+        # Padded width is kept through edge_contrib and the reduction:
+        # slicing here would either re-narrow the gather (XLA folds the
+        # slice in, reviving the scalarized path) or materialize both
+        # widths; pad lanes are zero, so contraction-style programs (CF's
+        # dot/err*src) are unaffected, and narrow (ne, K) arrays pad to
+        # the 128-lane tile physically anyway.
         src_vals = flat[dg["src_pidx"][0]]
         dst_ids = jnp.minimum(dg["dst_local"][0], max_nv - 1)
         dst_vals = v[dst_ids]
@@ -112,6 +138,11 @@ class ShardedPullExecutor:
             in_degrees=dg["in_degrees"][0],
         )
         new = prog.apply(v, acc, ctx)
+        if kp:
+            # Re-zero pad lanes: apply may write constants into them,
+            # which would pollute the next iteration's contractions.
+            lanes = jnp.arange(kp, dtype=jnp.int32)
+            new = jnp.where(lanes[None, :] < kr, new, 0)
         vmask = dg["vertex_mask"][0].reshape(
             (max_nv,) + (1,) * (new.ndim - 1)
         )
@@ -121,7 +152,16 @@ class ShardedPullExecutor:
     # -- driver ----------------------------------------------------------
 
     def init_values(self):
-        padded = self.sg.to_padded(self.program.init_values(self.graph))
+        return self.host_to_device(self.program.init_values(self.graph))
+
+    def host_to_device(self, host_vals: np.ndarray):
+        """Global (nv, *t) host array → this executor's device layout
+        (padded shard stack, lane-padded for K-vector programs)."""
+        padded = self.sg.to_padded(np.asarray(host_vals))
+        if self._kpad:
+            padded = np.pad(
+                padded, ((0, 0), (0, 0), (0, self._kpad - self._kreal))
+            )
         return jax.device_put(jnp.asarray(padded), parts_sharding(self.mesh))
 
     def step(self, vals):
@@ -140,4 +180,7 @@ class ShardedPullExecutor:
 
     def gather_values(self, vals) -> np.ndarray:
         """Padded device layout → global (nv, *t) host array."""
-        return self.sg.from_padded(np.asarray(jax.device_get(vals)))
+        host = np.asarray(jax.device_get(vals))
+        if self._kpad:
+            host = host[:, :, : self._kreal]
+        return self.sg.from_padded(host)

@@ -460,6 +460,9 @@ class ShardedTiledExecutor:
             out[p, :n] = internal[self._v_lo[p]: self._v_hi[p]]
         return jax.device_put(jnp.asarray(out), parts_sharding(self.mesh))
 
+    # The CLI's host→device protocol (cli._host_to_device).
+    host_to_device = _to_padded_internal
+
     def init_values(self) -> jnp.ndarray:
         return self._to_padded_internal(
             np.asarray(self.program.init_values(self.graph))

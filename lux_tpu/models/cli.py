@@ -234,9 +234,13 @@ def run_pull_app(program, argv, oracle=None):
     if program.needs_weights and g.weights is None:
         print(f"error: {program.name} needs a weighted graph", file=sys.stderr)
         return 1
-    value_bytes = int(np.dtype(np.float32).itemsize)
-    for d in getattr(program, "value_shape", ()):
-        value_bytes *= d
+    # Advisory sizes use the LANE-PADDED width: K-vector executors store
+    # and gather 128-lane-padded rows on device, so the unpadded size
+    # would understate HBM by the pad factor (6.4x for K=20).
+    from lux_tpu.engine.pull import lane_pad_width
+
+    kreal, kpad = lane_pad_width(getattr(program, "value_shape", ()))
+    value_bytes = int(np.dtype(np.float32).itemsize) * max(kpad or kreal, 1)
     memory_advisory(g, args.parts, value_bytes, push=False)
     ex = make_executor(g, program, args)
 
@@ -349,16 +353,11 @@ def _host_to_device(ex, host_vals):
     import jax
     import jax.numpy as jnp
 
-    if hasattr(ex, "_to_padded_internal"):
-        # Sharded tiled executor: its device layout is the padded
-        # degree-sorted shard stack; it owns the converter.
-        return ex._to_padded_internal(host_vals)
-    if hasattr(ex, "sg"):
-        from lux_tpu.parallel.mesh import parts_sharding
-
-        return jax.device_put(
-            jnp.asarray(ex.sg.to_padded(host_vals)), parts_sharding(ex.mesh)
-        )
+    if hasattr(ex, "host_to_device"):
+        # One protocol: executors owning a custom device layout (padded
+        # shard stacks, degree-sorted internal order, lane padding)
+        # provide the converter themselves.
+        return ex.host_to_device(host_vals)
     return jax.device_put(jnp.asarray(host_vals))
 
 

@@ -39,10 +39,18 @@ def build_parser(name: str, push: bool) -> argparse.ArgumentParser:
     p.add_argument("-check", action="store_true")
     p.add_argument("-verbose", action="store_true")
     p.add_argument(
-        "-parts", "-ng", type=int, default=1, dest="parts",
-        help="mesh devices to shard over (1 = single device); -ng is the "
-        "reference's alias for its GPU count (pagerank.cc:127)",
+        "-parts", "-ng", "-ll:gpu", type=int, default=1, dest="parts",
+        help="mesh devices to shard over (1 = single device); -ng and "
+        "-ll:gpu are the reference's aliases for its GPU count "
+        "(pagerank.cc:127, README.md:47)",
     )
+    # Accepted for drop-in compatibility with the reference's documented
+    # invocations (README.md:43-49); Legion memory sizing has no TPU
+    # equivalent — XLA owns HBM, and the advisory prints what is needed.
+    p.add_argument("-ll:fsize", type=int, dest="ll_fsize",
+                   help=argparse.SUPPRESS)
+    p.add_argument("-ll:zsize", type=int, dest="ll_zsize",
+                   help=argparse.SUPPRESS)
     p.add_argument(
         "-strategy", choices=["rowptr", "segment"], default="rowptr",
         help="sum-combiner reduction strategy (flat pull apps)",

@@ -121,3 +121,14 @@ def test_col_dst_cached():
     assert g.col_dst is a  # cached, not recomputed
     want = np.repeat(np.arange(g.nv), np.diff(g.row_ptr))
     np.testing.assert_array_equal(a, want)
+
+
+def test_lane_pad_width_policy():
+    from lux_tpu.engine.pull import lane_pad_width
+
+    assert lane_pad_width(()) == (0, 0)          # scalar values
+    assert lane_pad_width(None) == (0, 0)
+    assert lane_pad_width((20,)) == (20, 128)    # CF's K=20
+    assert lane_pad_width((128,)) == (128, 0)    # already lane-aligned
+    assert lane_pad_width((200,)) == (200, 256)
+    assert lane_pad_width((4, 5)) == (20, 0)     # rank-2: no lane pad

@@ -13,6 +13,7 @@ JAX async dispatch: `run()` enqueues every step and blocks once at the end.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -132,6 +133,7 @@ class _ChunkedGraph:
     bnd_pos: jnp.ndarray          # (nchunks, R) int32 local cumsum positions
     gather_idx: jnp.ndarray       # (nv+1,) int32 into (nchunks*R,) emits
     bnd_chunk: jnp.ndarray        # (nv+1,) int32 chunk of each boundary
+    dst_lo: jnp.ndarray           # (nchunks,) int32 clamped dst-slice starts
     out_degrees: jnp.ndarray      # (nv,) int32
     in_degrees: jnp.ndarray       # (nv,) int32
 
@@ -169,6 +171,36 @@ def _chunk_boundary_plan(row_ptr: np.ndarray, ne: int, chunk: int):
 # route through the scan path (overridable via LUX_EDGE_CHUNK_BYTES).
 EDGE_CHUNK_AUTO_BYTES = 2 << 30
 DEFAULT_EDGE_CHUNK = 1 << 20
+
+
+def _dst_slice_plan(col_dst: np.ndarray, ne: int, chunk: int, nv: int):
+    """Per-chunk dst-slice starts for the chunked engine's gather-cliff fix.
+
+    Edges are dst-sorted, so each edge chunk touches a narrow contiguous
+    band of destination rows. Gathering ``dst_vals`` from a per-chunk
+    ``dynamic_slice`` of the value table instead of the full table keeps
+    the gather under the big-table cliff (measured on the NetFlix-shaped
+    CF bench: a src+dst gather+dot from the 255 MB lane-padded table runs
+    at 22.2 ns/edge vs ~1.8 ns for sub-48MB tables — PERF.md "CF /
+    edge-chunked engine").
+
+    Returns ``(span, dst_lo)``: the static slice height (max band over
+    chunks, sublane-rounded) and the (nchunks,) clamped slice starts.
+    Starts are pre-clamped to ``nv - span`` on the host so the in-jit
+    local index ``cd - dst_lo`` is always within [0, span) for real
+    edges — no value-table padding needed.
+    """
+    nchunks = max(-(-ne // chunk), 1)
+    if ne == 0:
+        return 0, np.zeros(nchunks, np.int32)
+    starts = np.arange(nchunks, dtype=np.int64) * chunk
+    ends = np.minimum(starts + chunk, ne) - 1
+    lo = col_dst[starts].astype(np.int64)
+    hi = col_dst[ends].astype(np.int64)
+    span = int((hi - lo).max()) + 1
+    span = min(-(-span // 8) * 8, nv)
+    dst_lo = np.minimum(lo, nv - span).astype(np.int32)
+    return span, np.maximum(dst_lo, 0)
 
 
 def lane_pad_width(value_shape) -> tuple:
@@ -214,8 +246,6 @@ class PullExecutor:
         vshape = tuple(getattr(program, "value_shape", ()) or ())
         width = int(np.prod(vshape)) if vshape else 1
         if edge_chunk is None:
-            import os
-
             limit = int(
                 os.environ.get("LUX_EDGE_CHUNK_BYTES", EDGE_CHUNK_AUTO_BYTES)
             )
@@ -251,6 +281,18 @@ class PullExecutor:
             )
             pad = nchunks * C - graph.ne
 
+            # dst-slice gather (see _dst_slice_plan): auto-on when the
+            # slice traffic (nchunks x span rows/iter) is well under the
+            # edge gather traffic it replaces; LUX_DST_SLICE=0/1 overrides.
+            span, dst_lo = _dst_slice_plan(
+                graph.col_dst, graph.ne, C, graph.nv
+            )
+            knob = os.environ.get("LUX_DST_SLICE", "")
+            auto = 0 < span < graph.nv and nchunks * span <= graph.ne // 2
+            self._dst_span = span if (
+                (knob == "1" and span < graph.nv) or (knob != "0" and auto)
+            ) else 0
+
             def padded(a):
                 return np.pad(a, (0, pad)).reshape(nchunks, C)
 
@@ -264,10 +306,12 @@ class PullExecutor:
                 bnd_pos=put(bnd_pos),
                 gather_idx=put(gidx),
                 bnd_chunk=put(bchunk),
+                dst_lo=put(dst_lo),
                 out_degrees=put(graph.out_degrees.astype(np.int32)),
                 in_degrees=put(graph.in_degrees.astype(np.int32)),
             )
         else:
+            self._dst_span = 0
             eidx = _edge_index_dtype(graph.ne)
             self.dgraph = _DeviceGraph(
                 col_src=put(graph.col_src.astype(np.int32)),
@@ -330,9 +374,21 @@ class PullExecutor:
         k = self._kpad or kreal
 
         def body(_, ch):
-            cs, cd, w, bnd = ch
+            cs, cd, w, bnd, dlo = ch
+            if self._dst_span:
+                # dst ids are sorted, so this chunk's dst rows live in a
+                # narrow band: gather from a small dynamic slice instead
+                # of the full value table (the big-table gather cliff —
+                # PERF.md "CF / edge-chunked engine"). dlo is pre-clamped
+                # on the host so cd - dlo ∈ [0, span) for real edges.
+                band = jax.lax.dynamic_slice_in_dim(
+                    vals, dlo, self._dst_span, axis=0
+                )
+                dst_vals = band[cd - dlo]
+            else:
+                dst_vals = vals[cd]
             edge = EdgeCtx(
-                src_vals=vals[cs], dst_vals=vals[cd], weights=w,
+                src_vals=vals[cs], dst_vals=dst_vals, weights=w,
             )
             contrib = prog.edge_contrib(edge)
             c2 = contrib.reshape(contrib.shape[0], k)
@@ -343,12 +399,12 @@ class PullExecutor:
         w = dg.weights
         if w is None:
             _, (zb, totals) = jax.lax.scan(
-                lambda c, ch: body(c, (ch[0], ch[1], None, ch[2])),
-                0, (dg.col_src, dg.seg_ids, dg.bnd_pos),
+                lambda c, ch: body(c, (ch[0], ch[1], None, ch[2], ch[3])),
+                0, (dg.col_src, dg.seg_ids, dg.bnd_pos, dg.dst_lo),
             )
         else:
             _, (zb, totals) = jax.lax.scan(
-                body, 0, (dg.col_src, dg.seg_ids, w, dg.bnd_pos)
+                body, 0, (dg.col_src, dg.seg_ids, w, dg.bnd_pos, dg.dst_lo)
             )
         zg = zb.reshape(-1, k)[dg.gather_idx]           # (nv+1, k)
         ph, pl = _dd_prefix(totals)                     # (nchunks+1, k)
@@ -430,6 +486,6 @@ jax.tree_util.register_dataclass(
 jax.tree_util.register_dataclass(
     _ChunkedGraph,
     data_fields=["col_src", "seg_ids", "weights", "bnd_pos", "gather_idx",
-                 "bnd_chunk", "out_degrees", "in_degrees"],
+                 "bnd_chunk", "dst_lo", "out_degrees", "in_degrees"],
     meta_fields=[],
 )

@@ -74,6 +74,34 @@ def test_edge_chunked_scalar_program():
     )
 
 
+def test_edge_chunked_dst_slice_parity(monkeypatch):
+    # The dst-slice gather (per-chunk dynamic_slice band instead of a
+    # full-table gather — the big-table-cliff fix) must be numerically
+    # identical to the full gather for both K-vector and scalar programs.
+    from lux_tpu.models import PageRank
+
+    monkeypatch.setenv("LUX_DST_SLICE", "1")
+    g = bipartite_ratings(seed=5)
+    sliced = PullExecutor(g, CollaborativeFiltering(), edge_chunk=128)
+    assert sliced._dst_span > 0, "dst-slice path not enabled"
+    monkeypatch.setenv("LUX_DST_SLICE", "0")
+    full = PullExecutor(g, CollaborativeFiltering(), edge_chunk=128)
+    assert full._dst_span == 0
+    np.testing.assert_array_equal(
+        np.asarray(sliced.run(5)), np.asarray(full.run(5))
+    )
+
+    monkeypatch.setenv("LUX_DST_SLICE", "1")
+    gp = generate.rmat(10, 8, seed=3)
+    sliced = PullExecutor(gp, PageRank(), edge_chunk=512)
+    assert sliced._dst_span > 0
+    np.testing.assert_allclose(
+        np.asarray(sliced.run(5)),
+        np.asarray(PullExecutor(gp, PageRank(), edge_chunk=0).run(5)),
+        rtol=5e-5, atol=1e-9,
+    )
+
+
 def test_edge_chunked_auto_threshold(monkeypatch):
     # Auto mode picks chunked exactly when the flat (ne, K) contribution
     # array would cross LUX_EDGE_CHUNK_BYTES.

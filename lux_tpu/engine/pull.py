@@ -171,6 +171,10 @@ def _chunk_boundary_plan(row_ptr: np.ndarray, ne: int, chunk: int):
 # route through the scan path (overridable via LUX_EDGE_CHUNK_BYTES).
 EDGE_CHUNK_AUTO_BYTES = 2 << 30
 DEFAULT_EDGE_CHUNK = 1 << 20
+# Ceiling for the boundary-dense degrade path (growing windows / flat
+# fallback): any single contribution allocation past this is refused in
+# favor of the actionable "does not compress" error (v5e HBM is 16 GB).
+DEGRADE_CAP_BYTES = 4 << 30
 
 
 def _dst_slice_plan(col_dst: np.ndarray, ne: int, chunk: int, nv: int):
@@ -271,14 +275,53 @@ class PullExecutor:
         # re-zeroed after apply so programs whose apply adds constants
         # cannot leak garbage into the next iteration's contractions.
         self._kreal, self._kpad = lane_pad_width(vshape)
+
+        chunk_plan = None
+        if self.edge_chunk:
+            # On the AUTO-selected path a boundary-dense graph (a run of
+            # near-empty rows packed into one edge window) must degrade,
+            # not fail: retry with growing windows (fewer chunks bounds
+            # the padded emit table), then fall back to the flat engine.
+            # Degrading is only legal while the resulting contribution
+            # window stays under an absolute allocation cap — otherwise
+            # the "fallback" would be the very HBM-scale array chunking
+            # exists to avoid, traded for a silent OOM. An explicit
+            # edge_chunk override keeps the hard error either way.
+            C = self.edge_chunk
+            w_eff = max(self._kpad or self._kreal, 1)   # chunked row width
+            w_flat = max(self._kreal, 1)                # flat keeps layout
+            while True:
+                try:
+                    chunk_plan = _chunk_boundary_plan(
+                        graph.row_ptr, graph.ne, C
+                    )
+                    self.edge_chunk = C
+                    break
+                except ValueError:
+                    if edge_chunk is not None:
+                        raise
+                    nxt = min(C * 4, max(graph.ne, 1))
+                    if C < graph.ne and nxt * w_eff * 4 <= DEGRADE_CAP_BYTES:
+                        C = nxt
+                        continue
+                    if graph.ne * w_flat * 4 <= DEGRADE_CAP_BYTES:
+                        import warnings
+
+                        warnings.warn(
+                            "edge-chunked plan does not compress on this "
+                            "graph — degrading to the flat engine "
+                            f"({graph.ne * w_flat * 4 >> 20} MB flat "
+                            "contributions)"
+                        )
+                        self.edge_chunk = 0
+                        break
+                    raise   # no safe degrade: surface the actionable error
         if not self.edge_chunk:
             self._kpad = 0   # the flat path keeps the external layout
 
         if self.edge_chunk:
             C = self.edge_chunk
-            nchunks, bnd_pos, gidx, bchunk = _chunk_boundary_plan(
-                graph.row_ptr, graph.ne, C
-            )
+            nchunks, bnd_pos, gidx, bchunk = chunk_plan
             pad = nchunks * C - graph.ne
 
             # dst-slice gather (see _dst_slice_plan): auto-on when the

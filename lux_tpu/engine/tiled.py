@@ -55,58 +55,83 @@ def get_cached_plan(
     budget_bytes: int = 8 << 30,
     log=None,
     cap: int = 15,
+    pack: Optional[bool] = None,
 ) -> HybridPlan:
     """Load the hybrid plan cached at ``path`` (validating it against the
     graph), else plan and save. Planning costs minutes of host time at
     RMAT22+ scale and is graph-deterministic, so every entry point (CLI,
     bench) should come through here. A failed save (read-only graph dir)
-    degrades to planning without a cache."""
+    degrades to planning without a cache. ``pack`` is the caller's
+    nibble-packing intent (None = the LUX_PACK_STRIPS env default): a
+    cap-127 legacy cache is perfectly servable unless packing will
+    actually be used."""
     import os
 
-    from lux_tpu.ops.tiled_spmv import load_plan, save_plan
+    from lux_tpu.ops.tiled_spmv import load_plan, resolve_pack, save_plan
 
     say = log if log is not None else (lambda *_: None)
+    load_path = path
     if not os.path.exists(path) and path.endswith(".luxplan"):
         # Round-1 caches used a single .npz at the same key; serve them
-        # rather than replanning (load_plan keeps the legacy reader).
+        # rather than replanning (load_plan keeps the legacy reader). A
+        # replan still saves to the .luxplan path, not the legacy name.
         legacy = path[: -len(".luxplan")] + ".npz"
         if os.path.exists(legacy):
             say(f"serving legacy plan cache {legacy}")
-            path = legacy
-    if os.path.exists(path):
+            load_path = legacy
+    if os.path.exists(load_path):
         plan = None
         try:
-            plan = load_plan(path)
+            plan = load_plan(load_path)
         except Exception as e:
-            say(f"cached plan {path} unreadable ({e!r}) — replanning")
+            say(f"cached plan {load_path} unreadable ({e!r}) — replanning")
         if plan is not None and (
             plan.nv != graph.nv or plan.total_edges != graph.ne
         ):
             say(
-                f"cached plan {path} does not match graph "
+                f"cached plan {load_path} does not match graph "
                 f"(nv {plan.nv} vs {graph.nv}, edges {plan.total_edges} "
                 f"vs {graph.ne}) — replanning"
             )
             plan = None
-        # Config check: the cascade's r-sequence is recoverable from the
-        # plan; thresholds/budget are not stored, so a same-r cascade with
-        # a different thr/budget would still be served (callers that key
-        # the path by config, like the CLI default, never hit this).
+        # Config check. The cascade's r-sequence is recoverable from any
+        # plan; thresholds/budget are recorded by current saves
+        # (levels_spec/budget_bytes) and validated when present — legacy
+        # caches predating those fields pass on the r-sequence alone.
         want_rs = tuple(r for r, _ in levels)
         if plan is not None and tuple(l.r for l in plan.levels) != want_rs:
             say(
-                f"cached plan {path} has cascade r-levels "
+                f"cached plan {load_path} has cascade r-levels "
                 f"{tuple(l.r for l in plan.levels)}, requested {want_rs} "
                 "— replanning"
             )
             plan = None
-        # A plan capped tighter than requested is servable (it just
-        # spilled a few more overflow edges to the tail); a looser cap
-        # would break nibble packing, so replan.
-        if plan is not None and plan.cap > cap:
+        want_spec = tuple((int(r), int(t)) for r, t in levels)
+        if (
+            plan is not None
+            and plan.levels_spec is not None
+            and (
+                plan.levels_spec != want_spec
+                or plan.budget_bytes != int(budget_bytes)
+            )
+        ):
             say(
-                f"cached plan {path} has count cap {plan.cap}, requested "
-                f"<= {cap} (nibble packing needs <= 15) — replanning"
+                f"cached plan {load_path} was planned with "
+                f"levels={plan.levels_spec} budget={plan.budget_bytes}, "
+                f"requested levels={want_spec} budget={int(budget_bytes)} "
+                "— replanning"
+            )
+            plan = None
+        # A plan capped tighter than requested is servable (it just
+        # spilled a few more overflow edges to the tail). A looser cap
+        # only matters when nibble packing will actually be used — an
+        # unpacked run (the default and the measured-better config)
+        # serves cap-127 legacy plans as-is.
+        if plan is not None and plan.cap > cap and resolve_pack(pack, cap):
+            say(
+                f"cached plan {load_path} has count cap {plan.cap}, "
+                f"requested <= {cap} (nibble packing needs <= 15) "
+                "— replanning"
             )
             plan = None
         if plan is not None:

@@ -129,8 +129,9 @@ def small_world(
     seed: int = 0,
 ) -> Graph:
     """Watts-Strogatz-style ring lattice: vertex v points at its next
-    ``k`` ring neighbors, with a ``p_rewire`` fraction of endpoints
-    rewired uniformly at random.
+    ``k`` ring neighbors, with a ``p_rewire`` fraction of source
+    endpoints rewired uniformly at random (destinations keep their ring
+    position so the graph stays dst-major).
 
     The locality-rich synthetic stand-in for the reference's web/social
     benchmark graphs (Hollywood-2009, Indochina-2004 — README.md:79-86),
@@ -163,11 +164,14 @@ def bipartite_ratings(
     """Weighted bipartite ratings graph with edges in both directions
     (users 0..n_users-1, items n_users..n_users+n_items-1) — the
     NetFlix-shaped CF workload (480K users x 17.8K movies x 100M
-    ratings, README.md:85). Item popularity is Zipf-skewed like real
-    rating data; total directed edges = 2 * n_ratings."""
+    ratings, README.md:85). Item popularity is quadratically skewed
+    (a bounded inverse-transform — popular items get ~sqrt-density
+    weight, a milder skew than a true Zipf tail) so hub items exist
+    without the distribution degenerating; total directed edges =
+    2 * n_ratings."""
     rng = np.random.default_rng(seed)
     u = rng.integers(0, n_users, size=n_ratings, dtype=np.int64)
-    # Zipf-ish item popularity via inverse-power transform of uniforms.
+    # Quadratic inverse-transform of uniforms → denser low item ids.
     z = rng.random(n_ratings)
     items = (n_items * z ** 2.0).astype(np.int64).clip(0, n_items - 1)
     i = items + n_users

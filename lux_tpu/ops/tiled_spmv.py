@@ -48,7 +48,7 @@ a 128x128 systolic array.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,6 +119,11 @@ class HybridPlan:
     # (two strip rows per int8 byte — see pack_strips); legacy plans
     # used 127 and stay unpacked.
     cap: int = 15
+    # Planning config, kept so plan caches can detect a changed request
+    # (same r-cascade, different thresholds/budget). None/-1 on legacy
+    # caches that predate these fields — treated as "unknown, servable".
+    levels_spec: Optional[Tuple[Tuple[int, int], ...]] = None
+    budget_bytes: int = -1
 
     @property
     def num_strips(self) -> int:
@@ -429,6 +434,8 @@ def plan_hybrid(
         out_degrees=graph.out_degrees[order],
         in_degrees=graph.in_degrees[order],
         cap=cap,
+        levels_spec=tuple((int(r), int(t)) for r, t in levels),
+        budget_bytes=int(budget_bytes),
     )
 
 
@@ -462,6 +469,11 @@ def save_plan(path: str, plan: HybridPlan) -> None:
         levels=[lev.r for lev in plan.levels],
         level_edges=[lev.edges for lev in plan.levels],
         cap=plan.cap,
+        levels_spec=(
+            None if plan.levels_spec is None
+            else [list(rt) for rt in plan.levels_spec]
+        ),
+        budget_bytes=plan.budget_bytes,
     )
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f)
@@ -506,10 +518,16 @@ def load_plan(path: str, mmap: bool = True) -> HybridPlan:
             )
             for i, r in enumerate(meta["levels"])
         )
+        spec = meta.get("levels_spec")
         return HybridPlan(
             nv=int(meta["nv"]), nvb=int(meta["nvb"]),
             levels=levels,
             cap=int(meta.get("cap", 127)),
+            levels_spec=(
+                None if spec is None
+                else tuple((int(r), int(t)) for r, t in spec)
+            ),
+            budget_bytes=int(meta.get("budget_bytes", -1)),
             **{name: ld(name) for name in _PLAN_ARRAY_FIELDS},
         )
 
@@ -718,12 +736,19 @@ def strip_boundaries(rows: np.ndarray, nchunks: int, chunk: int, nrb: int,
 def resolve_pack(pack, plan_cap: int):
     """One shared gate for the nibble-packing decision: explicit ``pack``
     wins, else the LUX_PACK_STRIPS env opt-in; packing also requires the
-    plan's count cap to fit a nibble. Per-level, r must be even
-    (checked at the call sites via ``r % 2 == 0``)."""
+    plan's count cap to fit a nibble. An explicit ``pack=True`` that the
+    plan cannot satisfy raises (mirroring PushExecutor's blocked_dense
+    validation) — only the env opt-in degrades silently. Per-level, r
+    must be even (checked at the call sites via ``r % 2 == 0``)."""
     if pack is None:
         import os
 
         pack = bool(int(os.environ.get("LUX_PACK_STRIPS", "0")))
+    elif pack and plan_cap > 15:
+        raise ValueError(
+            f"pack=True needs a plan with count cap <= 15 (got cap="
+            f"{plan_cap}, a legacy/unpacked plan) — replan with cap<=15"
+        )
     return bool(pack) and plan_cap <= 15
 
 

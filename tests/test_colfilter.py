@@ -118,6 +118,26 @@ def test_edge_chunked_auto_threshold(monkeypatch):
     )
 
 
+def test_boundary_dense_auto_chunk_degrades(monkeypatch):
+    # A graph whose rows are nearly all empty packs too many row
+    # boundaries into one edge window; the AUTO path must degrade
+    # (larger windows, then the flat engine) instead of failing
+    # (ADVICE r2). An explicit edge_chunk keeps the hard error.
+    from lux_tpu.models import PageRank
+
+    g = generate.star_graph(1000)   # ne=999 < nv+1 boundaries
+    monkeypatch.setenv("LUX_EDGE_CHUNK_BYTES", "1")  # force auto-chunked
+    ex = PullExecutor(g, PageRank())
+    assert ex.edge_chunk == 0       # degraded to flat, not an error
+    np.testing.assert_allclose(
+        np.asarray(ex.run(3)),
+        np.asarray(PullExecutor(g, PageRank(), edge_chunk=0).run(3)),
+        rtol=5e-5, atol=1e-9,
+    )
+    with pytest.raises(ValueError, match="does not compress"):
+        PullExecutor(g, PageRank(), edge_chunk=64)
+
+
 def test_cf_requires_weights():
     g = generate.gnp(50, 200, seed=1)  # unweighted
     with pytest.raises(ValueError):

@@ -218,6 +218,56 @@ def test_plan_legacy_npz_load(tmp_path):
     np.testing.assert_array_equal(served.tail_sb, plan.tail_sb)
 
 
+def test_plan_cache_detects_config_change(tmp_path):
+    # Same r-cascade, different threshold or budget → replan, not serve
+    # (current saves record levels_spec/budget_bytes; ADVICE r2).
+    from lux_tpu.engine.tiled import get_cached_plan
+
+    g = generate.rmat(9, 8, seed=3)
+    path = str(tmp_path / "plan.luxplan")
+    first = get_cached_plan(g, path, levels=((8, 2),), budget_bytes=1 << 20)
+    assert first.levels_spec == ((8, 2),)
+    served = get_cached_plan(g, path, levels=((8, 2),), budget_bytes=1 << 20)
+    np.testing.assert_array_equal(served.tail_sb, first.tail_sb)
+    rethr = get_cached_plan(g, path, levels=((8, 4),), budget_bytes=1 << 20)
+    assert rethr.levels_spec == ((8, 4),)
+    assert rethr.tail_sb.shape[0] > first.tail_sb.shape[0]
+    rebud = get_cached_plan(g, path, levels=((8, 4),), budget_bytes=1 << 10)
+    assert rebud.budget_bytes == 1 << 10
+    assert rebud.num_strips < rethr.num_strips
+
+
+def test_legacy_cap_served_unless_packing(tmp_path):
+    # A cap-127 cache is fully servable when nibble packing is off (the
+    # default); only a real packing request forces the replan (ADVICE r2
+    # medium). The replan must land at the ORIGINAL .luxplan path.
+    import os
+
+    from lux_tpu.engine.tiled import get_cached_plan
+    from lux_tpu.ops.tiled_spmv import plan_hybrid as ph, save_plan
+
+    g = generate.rmat(9, 8, seed=3)
+    legacy = str(tmp_path / "plan.npz")
+    save_plan(legacy + ".dir", ph(g, levels=((8, 2),), cap=127))
+    os.rename(legacy + ".dir", legacy)   # simulate a legacy-keyed cache
+    path = str(tmp_path / "plan.luxplan")
+    served = get_cached_plan(g, path, levels=((8, 2),), cap=15)
+    assert served.cap == 127             # served, not replanned
+    assert not os.path.exists(path)
+    replanned = get_cached_plan(g, path, levels=((8, 2),), cap=15, pack=True)
+    assert replanned.cap <= 15
+    assert os.path.exists(path)          # saved under the .luxplan name
+
+
+def test_explicit_pack_on_unpackable_plan_raises():
+    from lux_tpu.ops.tiled_spmv import DeviceHybrid
+
+    g = generate.rmat(9, 8, seed=3)
+    plan = plan_hybrid(g, levels=((8, 2),), cap=127)
+    with pytest.raises(ValueError, match="cap"):
+        DeviceHybrid.build(plan, pack=True)
+
+
 def test_hybrid_run_resumes_from_external_vals():
     g = generate.rmat(9, 8, seed=5)
     ex = TiledPullExecutor(g, PageRank(), levels=((8, 1),), chunk_tail=64)

@@ -44,6 +44,7 @@ class ShardedPullExecutor:
         mesh: Optional[Mesh] = None,
         num_parts: Optional[int] = None,
         sum_strategy: str = "rowptr",
+        sg: Optional[ShardedGraph] = None,
     ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
@@ -52,7 +53,20 @@ class ShardedPullExecutor:
         self.graph = graph
         self.program = program
         self.sum_strategy = sum_strategy
-        self.sg = ShardedGraph.build(graph, self.num_parts)
+        if sg is not None and sg.num_parts != self.num_parts:
+            raise ValueError(
+                f"prebuilt ShardedGraph has {sg.num_parts} parts, mesh has "
+                f"{self.num_parts}"
+            )
+        if sg is not None and sg.graph is not graph:
+            raise ValueError(
+                "prebuilt ShardedGraph was built from a different Graph "
+                "object — edge indices and partition bounds would not "
+                "match this executor's graph"
+            )
+        self.sg = sg if sg is not None else ShardedGraph.build(
+            graph, self.num_parts
+        )
 
         # Lane padding for K-vector values: gathering (ne, K)-narrow rows
         # scalarizes on TPU (measured 76.5 s/iter on NetFlix-shaped CF in

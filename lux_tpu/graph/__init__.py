@@ -1,11 +1,12 @@
 from lux_tpu.graph.graph import Graph
-from lux_tpu.graph.format import read_lux, write_lux, detect_layout
+from lux_tpu.graph.format import (detect_layout, read_lux, read_lux_mmap, write_lux)
 from lux_tpu.graph.partition import edge_balanced_bounds, PartitionInfo
 from lux_tpu.graph import generate
 
 __all__ = [
     "Graph",
     "read_lux",
+    "read_lux_mmap",
     "write_lux",
     "detect_layout",
     "edge_balanced_bounds",

@@ -63,9 +63,16 @@ class Graph:
     @property
     def out_degrees(self) -> np.ndarray:
         if self._out_degrees is None:
-            self._out_degrees = np.bincount(
-                self.col_src, minlength=self.nv
-            ).astype(np.int64)
+            # Chunked so a memory-mapped col_src (read_lux_mmap at RMAT27
+            # scale) is streamed once instead of materialized, and the
+            # bincount temp stays bounded; harmless for in-RAM arrays.
+            chunk = 1 << 27
+            deg = np.zeros(self.nv, dtype=np.int64)
+            for s in range(0, self.ne, chunk):
+                deg += np.bincount(
+                    self.col_src[s : s + chunk], minlength=self.nv
+                )
+            self._out_degrees = deg
         return self._out_degrees
 
     @property

@@ -111,7 +111,6 @@ class ShardedGraph:
 
         g_out = graph.out_degrees
         g_in = graph.in_degrees
-        dst_all = graph.col_dst
         for p, ((l, r), (es, ee)) in enumerate(
             zip(info.bounds, info.edge_bounds)
         ):
@@ -119,13 +118,21 @@ class ShardedGraph:
             n_e = ee - es
             if n_v == 0:
                 continue
-            srcs = graph.col_src[es:ee].astype(np.int64)
+            # graph.col_src may be an np.memmap at RMAT27 scale
+            # (read_lux_mmap) — slice-then-convert keeps host cost to
+            # one part's edges at a time, and the local dsts come from
+            # the part's row_ptr slice rather than the global col_dst
+            # expansion (an 8.6 GB materialization at 2^31 edges).
+            srcs = np.asarray(graph.col_src[es:ee]).astype(np.int64)
             sp = part_of(srcs)
             src_pidx[p, :n_e] = (
                 sp * max_nv + (srcs - row_left_full[sp])
             ).astype(np.int32)
             src_global[p, :n_e] = srcs.astype(np.int32)
-            dst_local[p, :n_e] = (dst_all[es:ee] - l).astype(np.int32)
+            local_in = np.diff(graph.row_ptr[l : r + 2])
+            dst_local[p, :n_e] = np.repeat(
+                np.arange(n_v, dtype=np.int32), local_in
+            )
             edge_mask[p, :n_e] = True
             if weights is not None:
                 weights[p, :n_e] = graph.weights[es:ee]
@@ -155,6 +162,14 @@ class ShardedGraph:
             local_nv=part_nv.astype(np.int32),
             row_left=row_left_full,
         )
+
+    def release_edge_arrays(self):
+        """Drop the stacked per-edge host arrays (the ~13 bytes/edge that
+        dominate host RSS at RMAT27 scale) once they are resident on
+        device. ``to_padded``/``from_padded`` keep working — they only
+        need the partition bounds; ``build_push_csr`` does not."""
+        self.src_pidx = self.src_global = None
+        self.dst_local = self.edge_mask = self.weights = None
 
     # -- push-direction (CSR-by-global-src) view -------------------------
 

@@ -55,6 +55,29 @@ def test_lux_roundtrip_weighted(tmp_path):
     np.testing.assert_array_equal(g.weights, g2.weights)
 
 
+def test_read_lux_mmap_matches_read_lux(tmp_path):
+    # The RMAT27-scale mapped reader must agree with the materializing
+    # one, including precomputed out-degrees and weighted layouts, and
+    # must feed ShardedGraph.build identically (memmap col_src path).
+    from lux_tpu.graph import read_lux_mmap
+    from lux_tpu.parallel.shard import ShardedGraph
+
+    for weighted in (False, True):
+        g = generate.gnp(100, 700, seed=5, weighted=weighted)
+        p = str(tmp_path / f"m{int(weighted)}.lux")
+        write_lux(p, g)
+        a, b = read_lux(p), read_lux_mmap(p)
+        np.testing.assert_array_equal(a.row_ptr, b.row_ptr)
+        np.testing.assert_array_equal(a.col_src, np.asarray(b.col_src))
+        np.testing.assert_array_equal(a.out_degrees, b.out_degrees)
+        if weighted:
+            np.testing.assert_array_equal(a.weights, np.asarray(b.weights))
+        sa = ShardedGraph.build(a, 4)
+        sb = ShardedGraph.build(b, 4)
+        for f in ("src_pidx", "dst_local", "edge_mask", "local_row_ptr"):
+            np.testing.assert_array_equal(getattr(sa, f), getattr(sb, f))
+
+
 def test_binary_layout_is_reference_compatible(tmp_path):
     """Byte-level check of the layout in tools/converter.cc:108-124."""
     g = tiny_graph()

@@ -106,9 +106,10 @@ class ShardedPullExecutor:
 
     # -- per-shard body (runs under shard_map; block shapes (1, ...)) ----
 
-    def _shard_step(self, vals_blk, dg):
-        prog = self.program
-        max_nv = self.sg.max_nv
+    def _exchange_block(self, vals_blk):
+        """Value exchange: all-gather the shards into the flat global
+        table every shard gathers from (the reference's whole-region
+        zero-copy read, pull_model.inl:454-461)."""
         v = vals_blk[0]                  # (max_nv, *t); lane-padded if _kpad
         kp, kr = self._kpad, self._kreal
         if kp:
@@ -120,6 +121,13 @@ class ShardedPullExecutor:
         else:
             gathered = jax.lax.all_gather(v, PARTS_AXIS)  # (P, max_nv, *t)
             flat = gathered.reshape((-1,) + v.shape[1:])
+        return flat
+
+    def _comp_block(self, vals_blk, flat, dg):
+        """Edge gather + contribution + per-destination reduction."""
+        prog = self.program
+        max_nv = self.sg.max_nv
+        v = vals_blk[0]
         # Padded width is kept through edge_contrib and the reduction:
         # slicing here would either re-narrow the gather (XLA folds the
         # slice in, reviving the scalarized path) or materialize both
@@ -146,6 +154,14 @@ class ShardedPullExecutor:
                 num_segments=max_nv + 1,
                 kind=prog.combiner,
             )[:max_nv]
+        return acc
+
+    def _update_block(self, vals_blk, acc, dg):
+        """Vertex apply + pad-lane/pad-vertex re-masking."""
+        prog = self.program
+        max_nv = self.sg.max_nv
+        v = vals_blk[0]
+        kp, kr = self._kpad, self._kreal
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=dg["out_degrees"][0],
@@ -162,6 +178,11 @@ class ShardedPullExecutor:
         )
         new = jnp.where(vmask, new, v)  # freeze pad vertices
         return new[None]
+
+    def _shard_step(self, vals_blk, dg):
+        flat = self._exchange_block(vals_blk)
+        acc = self._comp_block(vals_blk, flat, dg)
+        return self._update_block(vals_blk, acc, dg)
 
     # -- driver ----------------------------------------------------------
 
@@ -180,6 +201,54 @@ class ShardedPullExecutor:
 
     def step(self, vals):
         return self._step(vals, self._device_graph)
+
+    def phase_step(self, vals):
+        """One iteration as separately-dispatched exchange/comp/update
+        phases for `-verbose` attribution (the pull-side analogue of the
+        reference's per-iteration breakdown, sssp/sssp_gpu.cu:516-518 —
+        phase names follow this engine's pipeline). SPMD phases are
+        mesh-lockstep, so the walls are mesh-wide. Returns (new vals,
+        {phase: seconds}). Phase dispatch breaks fusion; use run() for
+        timed loops."""
+        from lux_tpu.utils.timing import Timer
+
+        if not hasattr(self, "_pjits"):
+            specs = {k: P(PARTS_AXIS) for k in self._device_graph}
+
+            def sm(fn, in_specs, out_specs):
+                # check_vma off: the all-gathered flat table is
+                # replicated by construction, but the static checker
+                # cannot infer it here.
+                return jax.jit(jax.shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ))
+
+            self._pjits = {
+                "exchange": sm(
+                    lambda v: self._exchange_block(v),
+                    (P(PARTS_AXIS),), P(),
+                ),
+                "comp": sm(
+                    lambda v, flat, dg: self._comp_block(v, flat, dg)[None],
+                    (P(PARTS_AXIS), P(), specs), P(PARTS_AXIS),
+                ),
+                "update": sm(
+                    lambda v, acc, dg: self._update_block(v, acc[0], dg),
+                    (P(PARTS_AXIS), P(PARTS_AXIS), specs), P(PARTS_AXIS),
+                ),
+            }
+        j, dg, times = self._pjits, self._device_graph, {}
+        with Timer() as t:
+            flat = hard_sync(j["exchange"](vals))
+        times["exchange"] = t.elapsed
+        with Timer() as t:
+            acc = hard_sync(j["comp"](vals, flat, dg))
+        times["comp"] = t.elapsed
+        with Timer() as t:
+            new = hard_sync(j["update"](vals, acc, dg))
+        times["update"] = t.elapsed
+        return new, times
 
     def warmup(self):
         hard_sync(self.step(self.init_values()))

@@ -743,29 +743,67 @@ class ShardedPushExecutor:
         self._step = jax.jit(mapped, donate_argnums=0)
         self._chunk_cache = {}
 
-    def _iter_block(self, state: PushState, dg):
-        """One dense iteration on this shard's (1, ...) blocks; returns the
-        new blocks and the *local* new-frontier count."""
-        prog = self.program
-        max_nv = self.sg.max_nv
+    # Dense-iteration phases (load/comp/update split so phase_step can
+    # dispatch them separately for `-verbose`; _iter_block composes them
+    # into the fused step).
+
+    def _dense_load(self, state: PushState, dg):
+        """Exchange: all-gather the value+frontier shards (the whole-
+        region ZC reads, push_model.inl:234-241,250-257)."""
         v = state.values[0]
         f = state.frontier[0]
         if self.blocked_dense:
-            acc = self._blocked_dense_acc(v, f, dg)
-        else:
-            all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1)
-            all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1)
-            sidx = dg["src_pidx"][0]
-            src_vals = all_v[sidx]
-            src_front = all_f[sidx]
-            w = dg["weights"][0] if "weights" in dg else None
-            cand = prog.relax(src_vals, w)
-            ident = identity_for(prog.combiner, cand.dtype)
-            cand = jnp.where(src_front, cand, ident)
-            acc = segment_reduce(
-                cand, dg["dst_local"][0], num_segments=max_nv + 1,
-                kind=prog.combiner,
-            )[:max_nv]
+            packed = v.astype(jnp.uint32) | (f.astype(jnp.uint32) << 31)
+            allp = jax.lax.all_gather(packed, PARTS_AXIS).reshape(-1)
+            x2d = jnp.pad(allp, (0, (-allp.shape[0]) % 128)).reshape(-1, 128)
+            return (x2d,)
+        all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1)
+        all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1)
+        return all_v, all_f
+
+    def _dense_comp(self, loaded, dg):
+        """Relax + per-local-destination reduction; returns (acc, edges)
+        where edges counts this shard's frontier-sourced edges."""
+        prog = self.program
+        max_nv = self.sg.max_nv
+        if self.blocked_dense:
+            from lux_tpu.ops.segment import segment_minmax_by_rowptr
+
+            (x2d,) = loaded
+            has_w = "blk_w" in dg
+            chunks = (dg["blk_sb"][0], dg["blk_lane"][0], dg["blk_emask"][0])
+            if has_w:
+                chunks = chunks + (dg["blk_w"][0],)
+            cands = _blocked_candidates(
+                x2d, prog.relax, prog.combiner, chunks, has_w
+            )
+            acc = segment_minmax_by_rowptr(
+                cands[: self.sg.max_ne], dg["seg_start"][0],
+                dg["end_pos"][0], dg["row_nonempty"][0], prog.combiner,
+            )
+            return acc, jnp.int32(-1)   # frontier bits ride inside cands
+        all_v, all_f = loaded
+        sidx = dg["src_pidx"][0]
+        src_vals = all_v[sidx]
+        src_front = all_f[sidx]
+        w = dg["weights"][0] if "weights" in dg else None
+        cand = prog.relax(src_vals, w)
+        ident = identity_for(prog.combiner, cand.dtype)
+        cand = jnp.where(src_front, cand, ident)
+        acc = segment_reduce(
+            cand, dg["dst_local"][0], num_segments=max_nv + 1,
+            kind=prog.combiner,
+        )[:max_nv]
+        # Edge counter excludes pad slots (their src_pidx is 0, so a
+        # frontier-active vertex 0 would count every pad edge).
+        real = dg["dst_local"][0] != max_nv
+        return acc, (src_front & real).sum(dtype=jnp.int32)
+
+    def _merge_update(self, state: PushState, acc, dg):
+        """Value merge + new-frontier detection (shared by both dense
+        variants)."""
+        prog = self.program
+        v = state.values[0]
         if prog.combiner == "min":
             new = jnp.minimum(v, acc)
         else:
@@ -776,49 +814,38 @@ class ShardedPushExecutor:
         cnt = frontier.sum(dtype=jnp.int32)
         return PushState(new[None], frontier[None]), cnt
 
-    def _blocked_dense_acc(self, v, f, dg):
-        """Per-local-destination reduction via the packed-table blocked
-        path: ONE all-gather of (value | frontier<<31) uint32 shards
-        (half the plain path's value+frontier exchange bytes), row-gather
-        + lane-select candidate generation, segmented min/max scan."""
-        from lux_tpu.ops.segment import segment_minmax_by_rowptr
+    def _iter_block(self, state: PushState, dg):
+        """One dense iteration on this shard's (1, ...) blocks; returns the
+        new blocks and the *local* new-frontier count."""
+        loaded = self._dense_load(state, dg)
+        acc, _ = self._dense_comp(loaded, dg)
+        return self._merge_update(state, acc, dg)
 
-        prog = self.program
-        packed = v.astype(jnp.uint32) | (f.astype(jnp.uint32) << 31)
-        allp = jax.lax.all_gather(packed, PARTS_AXIS).reshape(-1)
-        x2d = jnp.pad(allp, (0, (-allp.shape[0]) % 128)).reshape(-1, 128)
-        has_w = "blk_w" in dg
-        chunks = (dg["blk_sb"][0], dg["blk_lane"][0], dg["blk_emask"][0])
-        if has_w:
-            chunks = chunks + (dg["blk_w"][0],)
-        cands = _blocked_candidates(
-            x2d, prog.relax, prog.combiner, chunks, has_w
-        )
-        return segment_minmax_by_rowptr(
-            cands[: self.sg.max_ne], dg["seg_start"][0],
-            dg["end_pos"][0], dg["row_nonempty"][0], prog.combiner,
-        )
+    # Sparse-iteration phases (same load/comp/update split).
 
-    def _sparse_block(self, state: PushState, dg):
-        """One sparse iteration: bounded local queue → all-gather of
-        (global ids, queued values) → expansion of the global queue
-        against this shard's local edges through the push CSR."""
-        prog = self.program
+    def _sparse_load(self, state: PushState, dg):
+        """Local frontier → bounded queue of global ids + values, then the
+        queue all-gather — the analogue of per-part frontier-chunk
+        streaming (sssp_gpu.cu:424-458); O(P*Q) bytes, not O(nv)."""
         nv, max_nv = self.graph.nv, self.sg.max_nv
-        Q, E = self.queue_cap, self.edge_budget
+        Q = self.queue_cap
         v = state.values[0]
         f = state.frontier[0]
-        # 1. Local frontier → bounded queue of global ids + values.
         q_loc = jnp.nonzero(f, size=Q, fill_value=max_nv)[0].astype(jnp.int32)
         qv = v[jnp.clip(q_loc, 0, max_nv - 1)]
         base = dg["row_left"][0, 0]
         qg = jnp.where(q_loc >= max_nv, jnp.int32(nv), base + q_loc)
-        # 2. Exchange: the analogue of per-part frontier-chunk streaming
-        # (sssp_gpu.cu:424-458) — O(P*Q) bytes, not O(nv).
         all_q = jax.lax.all_gather(qg, PARTS_AXIS).reshape(-1)    # (P*Q,)
         all_qv = jax.lax.all_gather(qv, PARTS_AXIS).reshape(-1)
-        # 3. Expand against local edges via the global-src CSR. Sentinel
-        # id nv reads deg == 0 (row_ptr is padded with two n_e entries).
+        return all_q, all_qv
+
+    def _sparse_comp(self, all_q, all_qv, dg):
+        """Expand the global queue against this shard's local edges via
+        the global-src CSR (sentinel id nv reads deg == 0 — row_ptr is
+        padded with two n_e entries). Returns (cand, dstl, edges)."""
+        prog = self.program
+        max_nv = self.sg.max_nv
+        E = self.edge_budget
         rp = dg["push_row_ptr"][0]
         start = rp[all_q]
         deg = rp[all_q + 1] - start
@@ -834,8 +861,15 @@ class ShardedPushExecutor:
         ident = identity_for(prog.combiner, cand.dtype)
         cand = jnp.where(emask, cand, ident)
         dstl = jnp.where(emask, dstl, max_nv)
-        # 4. Deterministic scatter-combine into local values (pad slot
-        # max_nv swallows masked edges).
+        return cand, dstl, emask.sum(dtype=jnp.int32)
+
+    def _sparse_update(self, state: PushState, cand, dstl, dg):
+        """Deterministic scatter-combine into local values (pad slot
+        max_nv swallows masked edges) + new-frontier detection."""
+        prog = self.program
+        max_nv = self.sg.max_nv
+        v = state.values[0]
+        ident = identity_for(prog.combiner, cand.dtype)
         vv = jnp.concatenate([v, jnp.full((1,), ident, v.dtype)])
         if prog.combiner == "min":
             new = vv.at[dstl].min(cand)[:max_nv]
@@ -847,15 +881,19 @@ class ShardedPushExecutor:
         cnt = frontier.sum(dtype=jnp.int32)
         return PushState(new[None], frontier[None]), cnt
 
-    def _one_iter_block(self, state: PushState, dg):
-        """Adaptive per-iteration branch; returns (state, local count,
-        took_sparse). The decision inputs are replicated collectives, so
-        every shard takes the same branch."""
-        if not self.sparse:
-            st, cnt = self._iter_block(state, dg)
-            return st, cnt, jnp.int32(0)
+    def _sparse_block(self, state: PushState, dg):
+        """One sparse iteration (fused composition of the three phases)."""
+        all_q, all_qv = self._sparse_load(state, dg)
+        cand, dstl, _ = self._sparse_comp(all_q, all_qv, dg)
+        return self._sparse_update(state, cand, dstl, dg)
+
+    def _decide_block(self, state: PushState, dg):
+        """Per-shard active count + the replicated sparse/dense branch
+        flag (pmax/psum collectives, so every shard agrees)."""
         f = state.frontier[0]
         cnt_loc = f.sum(dtype=jnp.int32)
+        if not self.sparse:
+            return cnt_loc, jnp.int32(0)
         oe_loc = jnp.where(
             f, dg["out_degrees"][0].astype(jnp.uint32), 0
         ).sum(dtype=jnp.uint32)
@@ -868,13 +906,22 @@ class ShardedPushExecutor:
         use_sparse = (cnt_max <= self.queue_cap) & (
             oe_tot <= jnp.uint32(self.edge_budget)
         )
+        return cnt_loc, use_sparse.astype(jnp.int32)
+
+    def _one_iter_block(self, state: PushState, dg):
+        """Adaptive per-iteration branch; returns (state, local count,
+        took_sparse)."""
+        _, use_sparse = self._decide_block(state, dg)
+        if not self.sparse:
+            st, cnt = self._iter_block(state, dg)
+            return st, cnt, jnp.int32(0)
         st, ncnt = jax.lax.cond(
-            use_sparse,
+            use_sparse.astype(bool),
             lambda s: self._sparse_block(s, dg),
             lambda s: self._iter_block(s, dg),
             state,
         )
-        return st, ncnt, use_sparse.astype(jnp.int32)
+        return st, ncnt, use_sparse
 
     def _shard_step(self, state: PushState, dg):
         new_state, cnt, _ = self._one_iter_block(state, dg)
@@ -928,6 +975,145 @@ class ShardedPushExecutor:
 
     def step(self, state: PushState):
         return self._step(state, self._dg)
+
+    # -- per-shard `-verbose` phases -------------------------------------
+
+    def _sharded_phase_jits(self):
+        """Separately-dispatched load/comp/update phase executables, each
+        a shard_map jit. SPMD phases run in lockstep across the mesh, so
+        the measured walls are mesh-wide; per-shard variation shows up in
+        the activeNodes/edges counters (which ARE per shard)."""
+        if hasattr(self, "_pjits"):
+            return self._pjits
+        state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
+        specs = self._specs
+
+        def sm(fn, in_specs, out_specs):
+            # check_vma off: all_gather outputs are replicated by
+            # construction but the static checker cannot infer it here.
+            mapped = jax.shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False,
+            )
+            return jax.jit(mapped)
+
+        n_loaded = 1 if self.blocked_dense else 2
+        j = {
+            "decide": sm(
+                lambda st, dg: tuple(
+                    a[None] for a in self._decide_block(st, dg)
+                ),
+                (state_spec, specs), (P(PARTS_AXIS), P(PARTS_AXIS)),
+            ),
+            "d_load": sm(
+                lambda st, dg: self._dense_load(st, dg),
+                (state_spec, specs),
+                tuple(P() for _ in range(n_loaded)),
+            ),
+            "d_comp": sm(
+                lambda loaded, dg: tuple(
+                    a[None] for a in self._dense_comp(loaded, dg)
+                ),
+                (tuple(P() for _ in range(n_loaded)), specs),
+                (P(PARTS_AXIS), P(PARTS_AXIS)),
+            ),
+            "update": sm(
+                lambda st, acc, dg: (
+                    lambda r: (r[0], r[1][None])
+                )(self._merge_update(st, acc[0], dg)),
+                (state_spec, P(PARTS_AXIS), specs),
+                (state_spec, P(PARTS_AXIS)),
+            ),
+        }
+        if self.sparse:
+            j["s_load"] = sm(
+                lambda st, dg: self._sparse_load(st, dg),
+                (state_spec, specs), (P(), P()),
+            )
+            j["s_comp"] = sm(
+                lambda q, qv, dg: tuple(
+                    a[None] for a in self._sparse_comp(q, qv, dg)
+                ),
+                (P(), P(), specs),
+                (P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS)),
+            )
+            j["s_update"] = sm(
+                lambda st, cand, dstl, dg: (
+                    lambda r: (r[0], r[1][None])
+                )(self._sparse_update(st, cand[0], dstl[0], dg)),
+                (state_spec, P(PARTS_AXIS), P(PARTS_AXIS), specs),
+                (state_spec, P(PARTS_AXIS)),
+            )
+        self._pjits = j
+        return j
+
+    def phase_step(self, state: PushState):
+        """One iteration as separately-dispatched load/comp/update phases
+        — the reference's per-GPU `-verbose` breakdown
+        (sssp/sssp_gpu.cu:516-518). Returns (new_state, total_active,
+        info): info carries the (mesh-lockstep) phase walls, the branch
+        taken, and a per-shard list with each shard's BEFORE-step
+        activeNodes and frontier-sourced edge count (-1 where the packed
+        blocked path folds frontier bits into the candidates). Phase
+        dispatch breaks fusion; use run() for timed fixpoints."""
+        from lux_tpu.utils.timing import Timer
+
+        j = self._sharded_phase_jits()
+        dg = self._dg
+        cnt_before, use_sparse = jax.device_get(j["decide"](state, dg))
+        cnt_before = np.asarray(cnt_before).reshape(-1)
+        use_sparse = bool(np.asarray(use_sparse).reshape(-1)[0])
+        times = {}
+        if use_sparse:
+            with Timer() as t:
+                all_q, all_qv = hard_sync(j["s_load"](state, dg))
+            times["loadTime"] = t.elapsed
+            with Timer() as t:
+                cand, dstl, edges = hard_sync(
+                    j["s_comp"](all_q, all_qv, dg)
+                )
+            times["compTime"] = t.elapsed
+            with Timer() as t:
+                new_state, cnt = hard_sync(
+                    j["s_update"](state, cand, dstl, dg)
+                )
+            times["updateTime"] = t.elapsed
+        else:
+            with Timer() as t:
+                loaded = hard_sync(j["d_load"](state, dg))
+            times["loadTime"] = t.elapsed
+            with Timer() as t:
+                acc, edges = hard_sync(j["d_comp"](loaded, dg))
+            times["compTime"] = t.elapsed
+            with Timer() as t:
+                new_state, cnt = hard_sync(j["update"](state, acc, dg))
+            times["updateTime"] = t.elapsed
+        times["branch"] = "sparse" if use_sparse else "dense"
+        edges_h = np.asarray(jax.device_get(edges)).reshape(-1)
+        times["shards"] = [
+            {"part": p, "activeNodes": int(cnt_before[p]),
+             "edges": int(edges_h[p])}
+            for p in range(self.num_parts)
+        ]
+        total = int(np.asarray(jax.device_get(cnt)).sum())
+        return new_state, total, times
+
+    def warmup_phases(self, state: PushState):
+        """Compile every phase executable — BOTH branches, not just the
+        one the given state would take — outside any timed region
+        (mirrors the single-device warmup_phases contract; otherwise the
+        first iteration on the other branch would report seconds of XLA
+        compile as its phase walls). ``state`` is read, never donated."""
+        j = self._sharded_phase_jits()
+        dg = self._dg
+        jax.device_get(j["decide"](state, dg))
+        loaded = j["d_load"](state, dg)
+        acc, _ = j["d_comp"](loaded, dg)
+        hard_sync(j["update"](state, acc, dg))
+        if self.sparse:
+            all_q, all_qv = j["s_load"](state, dg)
+            cand, dstl, _ = j["s_comp"](all_q, all_qv, dg)
+            hard_sync(j["s_update"](state, cand, dstl, dg))
 
     def run(
         self,

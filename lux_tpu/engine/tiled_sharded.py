@@ -387,10 +387,18 @@ class ShardedTiledExecutor:
         # Replicated helpers: block_map turns the gathered (P, max_nv)
         # shards into the global (nvb, 128) operand with one row gather
         # (block b of part p lives at flat row p*max_nvb + b - blk_lo[p]);
-        # blk_lo lets each shard slice its own span out of the psum-merged
-        # global strip accumulator.
+        # stack_map inverts it — stacked slot p*max_nvb + i → global block
+        # blk_lo[p] + i (or the sentinel zero row nvb for pad slots) — so
+        # the strip accumulator can be rearranged into owner-stacked
+        # layout and merged with a reduce-scatter instead of a full psum.
         owner = np.searchsorted(part.blk_hi, np.arange(plan.nvb), side="right")
         owner = np.minimum(owner, pcount - 1)
+        stack = np.full(pcount * max_nvb, plan.nvb, np.int32)
+        for p in range(pcount):
+            n = int(part.blk_hi[p] - part.blk_lo[p])
+            stack[p * max_nvb : p * max_nvb + n] = np.arange(
+                part.blk_lo[p], part.blk_hi[p], dtype=np.int32
+            )
         repl = jax.sharding.NamedSharding(self.mesh, P())
         self._replicated = {
             "block_map": jax.device_put(
@@ -400,22 +408,29 @@ class ShardedTiledExecutor:
                 ),
                 repl,
             ),
-            "blk_lo": jax.device_put(
-                jnp.asarray(part.blk_lo.astype(np.int32)), repl
-            ),
+            "stack_map": jax.device_put(jnp.asarray(stack), repl),
         }
         self._v_lo, self._v_hi = v_lo, v_hi
 
     # -- per-shard step (runs under shard_map) ---------------------------
 
-    def _shard_step(self, vals_blk, dg, repl):
-        hy: ShardedHybrid = dg["hybrid"]
+    def _exchange_block(self, vals_blk, repl):
+        """Value exchange: all-gather the shards and rearrange into the
+        global (nvb, 128) gather operand."""
         v = vals_blk[0]                                   # (max_nv,) f32
         gathered = jax.lax.all_gather(v, PARTS_AXIS)      # (P, max_nv)
-        x2d = gathered.reshape(-1, BLOCK)[repl["block_map"]]  # (nvb, 128)
+        return gathered.reshape(-1, BLOCK)[repl["block_map"]]  # (nvb, 128)
 
-        # Strips: each shard sums ITS strips into a full-height partial
-        # accumulator; psum merges, then the shard keeps its dst span.
+    def _strips_block(self, x2d, dg, repl):
+        """Strips: each shard sums ITS strips into a full-height partial
+        accumulator, rearranges it into owner-stacked block layout (one
+        cheap row gather; pad slots read the sentinel zero row), and a
+        tiled reduce-scatter hands every shard just its reduced span —
+        (P-1)*max_nv*4 ring bytes per device instead of the full-height
+        psum's 2(P-1)/P*nv_g*4 that capped large-P scaling (the
+        reference's per-part ZC publish never ships a full-nv array per
+        GPU either, core/pull_model.inl:454-461)."""
+        hy: ShardedHybrid = dg["hybrid"]
         nv_g = self.plan.nvb * BLOCK
         acc_g = jnp.zeros(nv_g, jnp.float32)
         for lev in hy.levels:
@@ -429,18 +444,24 @@ class ShardedTiledExecutor:
             acc_g = acc_g + strip_level_spmv(
                 x2d, dl, self.plan.nvb * (BLOCK // lev.r)
             )
-        acc_g = jax.lax.psum(acc_g, PARTS_AXIS)
-        start = repl["blk_lo"][jax.lax.axis_index(PARTS_AXIS)] * BLOCK
-        acc = jax.lax.dynamic_slice(
-            jnp.pad(acc_g, (0, self.max_nv)), (start,), (self.max_nv,)
-        )
-        acc = acc + lane_select_tail_sums(
+        acc2d = jnp.pad(acc_g.reshape(-1, BLOCK), ((0, 1), (0, 0)))
+        stacked = acc2d[repl["stack_map"]]     # (P*max_nvb, 128)
+        return jax.lax.psum_scatter(
+            stacked, PARTS_AXIS, scatter_dimension=0, tiled=True
+        ).reshape(-1)                          # (max_nv,) own span, reduced
+
+    def _tail_block(self, x2d, dg):
+        """Lane-select tail sums over this shard's owned dst span."""
+        hy: ShardedHybrid = dg["hybrid"]
+        return lane_select_tail_sums(
             x2d, hy.tail_sb[0], hy.tail_lane[0],
             hy.tail_bnd_row[0], hy.tail_bnd_grp[0],
             hy.tail_xing_idx[0], hy.tail_xing_s0[0], hy.tail_xing_s1[0],
             hy.tail_segs,
         )
 
+    def _apply_block(self, vals_blk, acc, dg):
+        v = vals_blk[0]
         ctx = VertexCtx(
             nv=self.graph.nv,
             out_degrees=dg["out_degrees"][0],
@@ -449,6 +470,12 @@ class ShardedTiledExecutor:
         new = self.program.apply(v, acc, ctx)
         new = jnp.where(dg["vertex_mask"][0], new, v)
         return new[None]
+
+    def _shard_step(self, vals_blk, dg, repl):
+        x2d = self._exchange_block(vals_blk, repl)
+        acc = self._strips_block(x2d, dg, repl)
+        acc = acc + self._tail_block(x2d, dg)
+        return self._apply_block(vals_blk, acc, dg)
 
     # -- driver (external vertex order at the API boundary) --------------
 
@@ -470,6 +497,61 @@ class ShardedTiledExecutor:
 
     def step(self, vals):
         return self._step(vals)
+
+    def phase_step(self, vals):
+        """One iteration as separately-dispatched exchange/strips/tail/
+        apply phases for `-verbose` attribution (phase names follow this
+        engine's pipeline, the analogue of the reference's per-iteration
+        breakdown, sssp/sssp_gpu.cu:516-518). SPMD phases are
+        mesh-lockstep, so the walls are mesh-wide. Returns (new vals,
+        {phase: seconds})."""
+        from lux_tpu.utils.timing import Timer
+
+        if not hasattr(self, "_pjits"):
+            specs = {k: P(PARTS_AXIS) for k in self._shard_args}
+
+            def sm(fn, in_specs, out_specs):
+                return jax.jit(jax.shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ))
+
+            self._pjits = {
+                "exchange": sm(
+                    lambda v, repl: self._exchange_block(v, repl),
+                    (P(PARTS_AXIS), P()), P(),
+                ),
+                "strips": sm(
+                    lambda x, dg, repl: self._strips_block(x, dg, repl)[None],
+                    (P(), specs, P()), P(PARTS_AXIS),
+                ),
+                "tail": sm(
+                    lambda x, dg: self._tail_block(x, dg)[None],
+                    (P(), specs), P(PARTS_AXIS),
+                ),
+                "apply": sm(
+                    lambda v, a, b, dg: self._apply_block(
+                        v, a[0] + b[0], dg
+                    ),
+                    (P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS), specs),
+                    P(PARTS_AXIS),
+                ),
+            }
+        j, times = self._pjits, {}
+        dg, repl = self._shard_args, self._replicated
+        with Timer() as t:
+            x2d = hard_sync(j["exchange"](vals, repl))
+        times["exchange"] = t.elapsed
+        with Timer() as t:
+            acc_s = hard_sync(j["strips"](x2d, dg, repl))
+        times["strips"] = t.elapsed
+        with Timer() as t:
+            acc_t = hard_sync(j["tail"](x2d, dg))
+        times["tail"] = t.elapsed
+        with Timer() as t:
+            new = hard_sync(j["apply"](vals, acc_s, acc_t, dg))
+        times["apply"] = t.elapsed
+        return new, times
 
     def warmup(self):
         hard_sync(self.step(self.init_values()))

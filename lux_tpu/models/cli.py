@@ -371,54 +371,44 @@ def _host_to_device(ex, host_vals):
 
 def _run_push_verbose(ex, state, max_iters, start_iter, init_kw):
     """Per-iteration `-verbose` loop for push apps, reproducing the
-    reference's per-part breakdown (sssp/sssp_gpu.cu:516-518):
+    reference's per-GPU breakdown (sssp/sssp_gpu.cu:516-518):
 
     - single device: `activeNodes, loadTime, compTime, updateTime` per
       iteration via the executor's separately-dispatched phase_step;
-    - sharded: one `part p: activeNodes` line per part per iteration
-      (phases are fused inside one SPMD program, so only wall time and
-      per-part active counts are separable).
+    - sharded: one `part p: activeNodes ... edges ...` line per part
+      per iteration with the phase walls on each line. SPMD phases run
+      in lockstep across the mesh, so the loadTime/compTime/updateTime
+      walls are mesh-wide (unlike the reference's per-GPU kernels);
+      per-shard skew shows in the activeNodes/edges counters.
     Disables chunked pipelining; timing is per-iteration synced."""
     import jax
 
     if state is None:
         state = ex.init_state(**init_kw)
     iters = 0
-    has_phases = hasattr(ex, "phase_step")
     # Compile outside the timed loop (warmup() only built the fused
-    # chunk executable; the phase jits and the sharded single-step are
-    # separate). The throwaway state absorbs any donation.
-    warm = ex.init_state(**init_kw)
-    if has_phases:
-        ex.warmup_phases(warm)
-    else:
-        ex.step(warm)
+    # chunk executable; the phase jits are separate executables). The
+    # throwaway state absorbs any donation.
+    ex.warmup_phases(ex.init_state(**init_kw))
     with Timer() as t:
         while max_iters is None or iters < max_iters:
-            if has_phases:
-                state, cnt, ph = ex.phase_step(state)
+            state, cnt, ph = ex.phase_step(state)
+            detail = (
+                f"loadTime {ph['loadTime']*1e6:.0f}us "
+                f"compTime {ph['compTime']*1e6:.0f}us "
+                f"updateTime {ph['updateTime']*1e6:.0f}us"
+            )
+            for s in ph.get("shards", ()):
                 print(
-                    f"iter {start_iter + iters}: activeNodes {cnt} "
-                    f"loadTime {ph['loadTime']*1e6:.0f}us "
-                    f"compTime {ph['compTime']*1e6:.0f}us "
-                    f"updateTime {ph['updateTime']*1e6:.0f}us "
-                    f"[{ph['branch']}]"
+                    f"iter {start_iter + iters} part {s['part']}: "
+                    f"activeNodes {s['activeNodes']} "
+                    f"edges {s['edges']} {detail} [{ph['branch']}]"
                 )
-                total = cnt
-            else:
-                with Timer() as ti:
-                    state, cnts = ex.step(state)
-                    cnts = np.asarray(jax.device_get(cnts)).reshape(-1)
-                for p, c in enumerate(cnts):
-                    print(
-                        f"iter {start_iter + iters} part {p}: "
-                        f"activeNodes {int(c)}"
-                    )
-                print(
-                    f"iter {start_iter + iters}: "
-                    f"{ti.elapsed*1e3:.3f} ms total"
-                )
-                total = int(cnts.sum())
+            print(
+                f"iter {start_iter + iters}: activeNodes {cnt} "
+                f"{detail} [{ph['branch']}]"
+            )
+            total = cnt
             iters += 1
             if total == 0:
                 break

@@ -75,10 +75,12 @@ def main():
         dt = (time.perf_counter() - t0) / iters
         # Analytic per-device per-iteration collective volume:
         # ring all-gather of the (max_nv,) f32 value shards ((P-1) segments
-        # egress per device) + ring psum (reduce-scatter + all-gather) of
-        # the full-height strip accumulator (nvb*128 f32, 2(P-1)/P).
+        # egress per device) + tiled reduce-scatter of the owner-stacked
+        # strip accumulator ((P-1) tiles of max_nv f32 egress per device —
+        # round 2's full-height psum cost 2(P-1)/P * nvb*128*4 and grew
+        # toward 2x the global accumulator at large P).
         ag = (p - 1) * ex.max_nv * 4
-        ps = 2 * (p - 1) * (plan.nvb * 128 * 4) // max(p, 1)
+        ps = (p - 1) * ex.max_nv * 4
         res = {
             "parts": p,
             "ms_per_iter": round(dt * 1e3, 1),

@@ -3,11 +3,15 @@
 Distribution design — the two layouts are two independent resources and
 are balanced separately:
 
-- **Tail edges** are owner-computes over a contiguous dst partition (the
-  reference's edge-balanced contiguous vertex partitioning,
-  pull_model.inl:108-131, in the plan's degree-sorted internal order at
-  128-block granularity), balanced by tail-edge count with a span term so
-  no shard's padded vertex span blows up.
+- **Tail edges** are owner-computes over a NON-contiguous dst partition:
+  128-blocks are snake-dealt to parts by descending tail cost (see
+  PlanPartition), balancing both the per-part block counts (which size
+  every padded array and the per-iteration collectives) and the tail
+  bytes to ~1x — a contiguous cut on the degree-sorted order (the
+  reference's scheme, pull_model.inl:108-131, which partitions natural
+  order) could only trade ~2x padding against ~2x tail skew. Each
+  part's tail edges are the gathered concatenation of its owned blocks'
+  CSC ranges, dst-sorted within the part.
 - **Strips** are sharded by strip index in equal counts (degree sort
   concentrates strips onto hub destinations, so a dst partition would
   hand one shard nearly all strip bytes — and SPMD padding would then
@@ -79,22 +83,33 @@ TAIL_EDGE_COST = 512
 
 @dataclasses.dataclass(eq=False)
 class PlanPartition:
-    """P contiguous 128-block runs over a plan's internal dst space."""
+    """Ownership of the plan's dst 128-blocks across P parts.
 
-    blk_lo: np.ndarray   # (P,) int64, inclusive
-    blk_hi: np.ndarray   # (P,) int64, exclusive
-    max_nvb: int         # max blocks owned by any part
+    Ownership is NON-contiguous: on the degree-sorted internal order the
+    tail concentrates in the leaf (late) blocks, so any contiguous cut
+    must trade padded-span blowup against tail imbalance (measured on
+    RMAT24: the best contiguous balance is ~2x padding AND ~2x tail
+    skew, and the padding directly inflates every per-iteration
+    all-gather/reduce-scatter). Snake-dealing blocks by descending tail
+    cost balances both to ~1x. The reference partitions the NATURAL
+    vertex order where contiguous edge-balanced cuts suffice
+    (pull_model.inl:108-131); degree sorting is what forces the
+    generalization here."""
+
+    owner: np.ndarray     # (nvb,) int32 owning part per block
+    blocks: tuple         # P arrays: owned block ids, ascending
+    max_nvb: int          # max blocks owned by any part (= ceil(nvb/P))
 
     @property
     def num_parts(self) -> int:
-        return self.blk_lo.shape[0]
+        return len(self.blocks)
 
 
 def partition_plan(plan: HybridPlan, num_parts: int) -> PlanPartition:
-    """Contiguous sweep over dst 128-blocks, balanced by tail-edge bytes
-    (the reference's edge-balanced contiguous partitioning,
-    pull_model.inl:108-131, under the TPU cost model), via quantile cuts
-    of the cumulative cost so no shard's block SPAN can blow up either.
+    """Snake-deal dst 128-blocks to parts by descending tail-edge cost:
+    part counts balance exactly (each part takes every P-th block of the
+    cost-sorted order) and tail bytes balance to ~1x because adjacent
+    cost ranks alternate direction each round.
 
     Strips are NOT in this cost: they are sharded separately by strip
     index (see module docstring), so the dst partition only has to
@@ -104,32 +119,19 @@ def partition_plan(plan: HybridPlan, num_parts: int) -> PlanPartition:
     tail_per_blk = np.pad(
         tail_per_v, (0, nvb * BLOCK - plan.nv)
     ).reshape(nvb, BLOCK).sum(axis=1)
-    cost = tail_per_blk * TAIL_EDGE_COST
 
-    # Per-block span term: degree-sorted order concentrates strip bytes in
-    # the first blocks, so pure byte balance would give the leaf-heavy last
-    # shard a span of most of the graph — and every shard's padded arrays
-    # (and the per-iteration all-gather) are sized by the WORST span. One
-    # average block-cost per block makes every block cost >= alpha, so a
-    # shard's per-part quota (2*total0/P) bounds its span at 2*nvb/P + 1
-    # for at most 2x byte skew.
-    cost = cost + max(int(cost.sum()) // nvb, 1)
-
-    # Quantile cuts of the cumulative cost: block b belongs to the part its
-    # exclusive prefix falls into. Monotone by construction; unlike a
-    # cap-greedy sweep, leftovers can't pile onto the last part.
-    prefix = np.concatenate([[0], np.cumsum(cost[:-1])])
-    owner = np.minimum(
-        prefix * num_parts // int(cost.sum()), num_parts - 1
-    ).astype(np.int64)
-    parts = np.arange(num_parts, dtype=np.int64)
-    blk_lo = np.searchsorted(owner, parts, side="left").astype(np.int64)
-    blk_hi = np.searchsorted(owner, parts, side="right").astype(np.int64)
-    assert blk_hi[-1] == nvb and (blk_hi >= blk_lo).all()
-    spans = blk_hi - blk_lo
-    return PlanPartition(
-        blk_lo=blk_lo, blk_hi=blk_hi, max_nvb=int(max(spans.max(), 1))
+    order = np.argsort(-tail_per_blk, kind="stable")
+    owner = np.empty(nvb, np.int32)
+    ranks = np.arange(nvb, dtype=np.int64)
+    rounds, pos = divmod(ranks, num_parts)
+    snake = np.where(rounds % 2 == 0, pos, num_parts - 1 - pos)
+    owner[order] = snake.astype(np.int32)
+    blocks = tuple(
+        np.flatnonzero(owner == p).astype(np.int64)
+        for p in range(num_parts)
     )
+    max_nvb = max(max(b.shape[0] for b in blocks), 1)
+    return PlanPartition(owner=owner, blocks=blocks, max_nvb=int(max_nvb))
 
 
 @dataclasses.dataclass
@@ -185,6 +187,20 @@ for _cls, _data, _meta in (
      ["tail_segs", "max_nvb"]),
 ):
     jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=_meta)
+
+
+def _ranges_to_indices(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Concatenate [starts[i], starts[i]+lens[i]) ranges into one index
+    array (vectorized; the tail-edge gather list of a part's owned
+    blocks)."""
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    offs = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return (
+        np.arange(total, dtype=np.int64)
+        + np.repeat(starts - offs, lens)
+    )
 
 
 def _pad_stack(arrs, width: int, dtype=np.int32) -> np.ndarray:
@@ -329,13 +345,25 @@ class ShardedTiledExecutor:
                 xing_s1=put(_pad_stack(s1s, xmax)),
             ))
 
-        # Tail slices (CSC by dst => contiguous per part) + per-part
-        # static boundary gather data over the LOCAL row ptrs.
-        v_lo = np.minimum(part.blk_lo * BLOCK, plan.nv)
-        v_hi = np.minimum(part.blk_hi * BLOCK, plan.nv)
-        e_lo = plan.tail_row_ptr[v_lo]
-        e_hi = plan.tail_row_ptr[v_hi]
-        mmax = max(int((e_hi - e_lo).max()), 0)
+        # Tail slices + per-part static boundary gather data over the
+        # LOCAL row ptrs. Ownership is non-contiguous (snake-dealt
+        # blocks), so each part's local vertex space is the ascending
+        # concatenation of its owned blocks' vertex ranges and its tail
+        # edges the matching gather of per-block edge ranges — the
+        # Z-stream machinery only needs the LOCAL stream and row ptrs,
+        # which stay dst-sorted within the part by construction.
+        tail_per_v = np.diff(plan.tail_row_ptr).astype(np.int64)
+        self._vidx = []
+        part_ne = []
+        for p in range(pcount):
+            B = part.blocks[p]
+            vs = B * BLOCK
+            vidx = (vs[:, None] + np.arange(BLOCK, dtype=np.int64)).ravel()
+            vidx = vidx[vidx < plan.nv]
+            # int32 suffices (nv < 2^31) and these persist per executor.
+            self._vidx.append(vidx.astype(np.int32))
+            part_ne.append(int(tail_per_v[vidx].sum()))
+        mmax = max(part_ne) if part_ne else 0
         c_tail = round_chunk(chunk_tail, mmax, 1)
         mpad = -(-max(mmax, 1) // c_tail) * c_tail
         k2 = mpad // c_tail
@@ -348,17 +376,22 @@ class ShardedTiledExecutor:
         deg_in = np.zeros((pcount, self.max_nv), np.int64)
         vmask = np.zeros((pcount, self.max_nv), bool)
         for p in range(pcount):
-            m = e_hi[p] - e_lo[p]
-            nvloc = v_hi[p] - v_lo[p]
-            sb[p, :m] = plan.tail_sb[e_lo[p]:e_hi[p]]
-            lane[p, :m] = plan.tail_lane[e_lo[p]:e_hi[p]]
+            vidx = self._vidx[p]
+            nvloc = vidx.shape[0]
+            m = part_ne[p]
+            starts = plan.tail_row_ptr[vidx]
+            lens = tail_per_v[vidx]
+            eidx = _ranges_to_indices(starts, lens)
+            sb[p, :m] = plan.tail_sb[eidx]
+            lane[p, :m] = plan.tail_lane[eidx]
             rp = np.full(self.max_nv + 1, m, np.int64)
-            rp[: nvloc + 1] = plan.tail_row_ptr[v_lo[p]: v_hi[p] + 1] - e_lo[p]
+            np.cumsum(lens, out=rp[1 : nvloc + 1])
+            rp[0] = 0
             trow[p], tgrp[p], sub = zstream_boundaries(rp, c_tail, 1)
             xi, s0, s1 = crossing_correction(sub, 1)
             xis.append(xi); s0s.append(s0); s1s.append(s1)
-            deg_out[p, :nvloc] = plan.out_degrees[v_lo[p]:v_hi[p]]
-            deg_in[p, :nvloc] = plan.in_degrees[v_lo[p]:v_hi[p]]
+            deg_out[p, :nvloc] = plan.out_degrees[vidx]
+            deg_in[p, :nvloc] = plan.in_degrees[vidx]
             vmask[p, :nvloc] = True
         xmax = max((a.shape[0] for a in s0s), default=0)
         cs_t = c_tail // BLOCK
@@ -386,31 +419,29 @@ class ShardedTiledExecutor:
 
         # Replicated helpers: block_map turns the gathered (P, max_nv)
         # shards into the global (nvb, 128) operand with one row gather
-        # (block b of part p lives at flat row p*max_nvb + b - blk_lo[p]);
-        # stack_map inverts it — stacked slot p*max_nvb + i → global block
-        # blk_lo[p] + i (or the sentinel zero row nvb for pad slots) — so
-        # the strip accumulator can be rearranged into owner-stacked
-        # layout and merged with a reduce-scatter instead of a full psum.
-        owner = np.searchsorted(part.blk_hi, np.arange(plan.nvb), side="right")
-        owner = np.minimum(owner, pcount - 1)
+        # (block b lives at flat row owner[b]*max_nvb + its rank within
+        # the owner's ascending block list); stack_map inverts it —
+        # stacked slot p*max_nvb + i → the p-th part's i-th owned block
+        # (or the sentinel zero row nvb for pad slots) — so the strip
+        # accumulator can be rearranged into owner-stacked layout and
+        # merged with a reduce-scatter instead of a full psum.
+        rank_in_owner = np.zeros(plan.nvb, np.int64)
         stack = np.full(pcount * max_nvb, plan.nvb, np.int32)
         for p in range(pcount):
-            n = int(part.blk_hi[p] - part.blk_lo[p])
-            stack[p * max_nvb : p * max_nvb + n] = np.arange(
-                part.blk_lo[p], part.blk_hi[p], dtype=np.int32
-            )
+            B = part.blocks[p]
+            rank_in_owner[B] = np.arange(B.shape[0], dtype=np.int64)
+            stack[p * max_nvb : p * max_nvb + B.shape[0]] = B
         repl = jax.sharding.NamedSharding(self.mesh, P())
         self._replicated = {
             "block_map": jax.device_put(
                 jnp.asarray(
-                    (owner * max_nvb + np.arange(plan.nvb)
-                     - part.blk_lo[owner]).astype(np.int32)
+                    (part.owner.astype(np.int64) * max_nvb
+                     + rank_in_owner).astype(np.int32)
                 ),
                 repl,
             ),
             "stack_map": jax.device_put(jnp.asarray(stack), repl),
         }
-        self._v_lo, self._v_hi = v_lo, v_hi
 
     # -- per-shard step (runs under shard_map) ---------------------------
 
@@ -483,8 +514,8 @@ class ShardedTiledExecutor:
         internal = np.asarray(ext_vals)[self.plan.order]
         out = np.zeros((self.num_parts, self.max_nv), internal.dtype)
         for p in range(self.num_parts):
-            n = self._v_hi[p] - self._v_lo[p]
-            out[p, :n] = internal[self._v_lo[p]: self._v_hi[p]]
+            vidx = self._vidx[p]
+            out[p, : vidx.shape[0]] = internal[vidx]
         return jax.device_put(jnp.asarray(out), parts_sharding(self.mesh))
 
     # The CLI's host→device protocol (cli._host_to_device).
@@ -567,10 +598,8 @@ class ShardedTiledExecutor:
     def gather_values(self, vals) -> np.ndarray:
         """Sharded padded internal layout -> global EXTERNAL (nv,) array."""
         host = np.asarray(jax.device_get(vals))
-        internal = np.concatenate(
-            [
-                host[p, : self._v_hi[p] - self._v_lo[p]]
-                for p in range(self.num_parts)
-            ]
-        )
+        internal = np.empty(self.plan.nv, host.dtype)
+        for p in range(self.num_parts):
+            vidx = self._vidx[p]
+            internal[vidx] = host[p, : vidx.shape[0]]
         return internal[self.plan.rank]

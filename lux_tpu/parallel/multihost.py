@@ -54,17 +54,14 @@ def initialize(
             raise
 
 
-def make_global_mesh(num_parts: Optional[int] = None) -> Mesh:
-    """1-D ``parts`` mesh over all global devices, slice-major ordered.
-
-    ``num_parts`` may only shrink the mesh as long as every participating
-    process keeps at least one device — in multi-controller JAX all
-    processes must own a piece of the computation.
-    """
-    import jax
-
+def ordered_devices(devices, num_parts: Optional[int] = None):
+    """Slice-major device ordering + the shrink validation, as a pure
+    function over anything device-shaped (slice_index / process_index /
+    id attributes) so it is unit-testable without a real multi-host
+    topology. Returns the full ordered list (shrinking happens in
+    make_mesh); raises if ``num_parts`` would orphan a process."""
     devices = sorted(
-        jax.devices(),
+        devices,
         key=lambda d: (
             getattr(d, "slice_index", 0) or 0,
             d.process_index,
@@ -81,4 +78,18 @@ def make_global_mesh(num_parts: Optional[int] = None) -> Mesh:
                 f"processes {sorted(all_procs - kept_procs)}; all "
                 "processes must participate in a multi-controller mesh"
             )
-    return make_mesh(num_parts, devices=devices)
+    return devices
+
+
+def make_global_mesh(num_parts: Optional[int] = None) -> Mesh:
+    """1-D ``parts`` mesh over all global devices, slice-major ordered.
+
+    ``num_parts`` may only shrink the mesh as long as every participating
+    process keeps at least one device — in multi-controller JAX all
+    processes must own a piece of the computation.
+    """
+    import jax
+
+    return make_mesh(
+        num_parts, devices=ordered_devices(jax.devices(), num_parts)
+    )

@@ -18,22 +18,32 @@ def test_partition_plan_covers_blocks_disjointly():
     g = generate.rmat(10, 8, seed=3)
     plan = plan_hybrid(g, levels=((8, 2),))
     part = partition_plan(plan, 8)
-    assert part.blk_lo[0] == 0 and part.blk_hi[-1] == plan.nvb
-    for p in range(1, 8):
-        assert part.blk_lo[p] == part.blk_hi[p - 1]
+    seen = np.concatenate(part.blocks)
+    assert np.array_equal(np.sort(seen), np.arange(plan.nvb))
+    for p, blocks in enumerate(part.blocks):
+        assert np.array_equal(part.owner[blocks], np.full(len(blocks), p))
+        assert np.array_equal(blocks, np.sort(blocks))   # ascending
     assert part.max_nvb >= 1
 
 
-def test_partition_plan_bounds_worst_span():
-    # Degree-sorted order piles strip bytes into the first blocks; pure
-    # byte balance would hand the leaf-heavy last shard most of the vertex
-    # space, and all padded per-shard arrays are sized by the WORST span.
-    # The span term keeps max span near 2x the mean.
+def test_partition_plan_balances_counts_and_tail():
+    # Snake-dealing by descending tail cost must balance BOTH the block
+    # counts (padding: every padded per-shard array and the per-iteration
+    # all-gather/reduce-scatter are sized by the WORST count) and the
+    # tail-edge bytes (per-iteration work) — the contiguous cut could
+    # only trade one against the other (~2x each on degree-sorted order).
     g = generate.rmat(14, 8, seed=2)
     plan = plan_hybrid(g, levels=((8, 2),))
+    tail_per_v = np.diff(plan.tail_row_ptr)
+    tail_blk = np.pad(
+        tail_per_v, (0, plan.nvb * BLOCK - plan.nv)
+    ).reshape(plan.nvb, BLOCK).sum(axis=1)
     for parts in (4, 8):
         part = partition_plan(plan, parts)
-        assert part.max_nvb <= max(2 * plan.nvb // parts + 2, 2)
+        counts = np.array([len(b) for b in part.blocks])
+        assert part.max_nvb == counts.max() == -(-plan.nvb // parts)
+        tails = np.array([tail_blk[b].sum() for b in part.blocks])
+        assert tails.max() <= 1.10 * max(tails.mean(), 1)
 
 
 def test_partition_plan_more_parts_than_blocks():
@@ -41,9 +51,8 @@ def test_partition_plan_more_parts_than_blocks():
     plan = plan_hybrid(g, levels=((8, 1),))
     assert plan.nvb < 8
     part = partition_plan(plan, 8)
-    spans = part.blk_hi - part.blk_lo
-    assert spans.min() >= 0 and spans.sum() == plan.nvb
-    assert part.blk_hi[-1] == plan.nvb
+    counts = np.array([len(b) for b in part.blocks])
+    assert counts.sum() == plan.nvb and counts.max() <= 1
 
 
 @pytest.mark.parametrize(

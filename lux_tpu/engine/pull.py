@@ -134,6 +134,8 @@ class _ChunkedGraph:
     gather_idx: jnp.ndarray       # (nv+1,) int32 into (nchunks*R,) emits
     bnd_chunk: jnp.ndarray        # (nv+1,) int32 chunk of each boundary
     dst_lo: jnp.ndarray           # (nchunks,) int32 clamped dst-slice starts
+    src_lo: jnp.ndarray           # (nchunks,) int32 clamped src-band starts
+    src_banded: jnp.ndarray       # (nchunks,) bool — chunk uses the band
     out_degrees: jnp.ndarray      # (nv,) int32
     in_degrees: jnp.ndarray       # (nv,) int32
 
@@ -205,6 +207,45 @@ def _dst_slice_plan(col_dst: np.ndarray, ne: int, chunk: int, nv: int):
     span = min(-(-span // 8) * 8, nv)
     dst_lo = np.minimum(lo, nv - span).astype(np.int32)
     return span, np.maximum(dst_lo, 0)
+
+
+def _src_slice_plan(col_src: np.ndarray, ne: int, chunk: int, nv: int,
+                    row_bytes: int):
+    """Per-chunk SOURCE-band plan for the chunked engine.
+
+    Unlike destinations, sources are not sorted — but structured graphs
+    give many chunks a narrow source RANGE anyway: in the NetFlix-shaped
+    bipartite CF graph every user-destination chunk draws sources only
+    from the item id range (a ~9 MB band of the 255 MB value table —
+    the PERF.md round-2 "item-side src slice" lever). Chunks whose
+    source span fits under the big-table gather cliff serve ``src_vals``
+    from a per-chunk ``dynamic_slice`` (selected per chunk by a traced
+    ``lax.cond`` flag); wide chunks keep the full-table gather.
+
+    Returns ``(span, src_lo, banded)``: the static slice height (max
+    span over BANDED chunks; 0 = no chunk qualifies), clamped starts,
+    and the per-chunk flag array.
+    """
+    from lux_tpu.ops.tiled_spmv import GATHER_TABLE_BYTES
+
+    nchunks = max(-(-ne // chunk), 1)
+    zero = (0, np.zeros(nchunks, np.int32), np.zeros(nchunks, bool))
+    if ne == 0:
+        return zero
+    edges = np.arange(nchunks + 1, dtype=np.int64) * chunk
+    edges[-1] = ne
+    lo = np.minimum.reduceat(col_src[:ne], edges[:-1]).astype(np.int64)
+    hi = np.maximum.reduceat(col_src[:ne], edges[:-1]).astype(np.int64)
+    spans = hi - lo + 1
+    cap = max(GATHER_TABLE_BYTES // max(row_bytes, 1), 1)
+    banded = spans <= cap
+    if not banded.any() or nv <= cap:
+        # nv <= cap: the full table is already under the cliff.
+        return zero
+    span = int(spans[banded].max())
+    span = min(-(-span // 8) * 8, nv)
+    src_lo = np.clip(lo, 0, nv - span).astype(np.int32)
+    return span, src_lo, banded
 
 
 def lane_pad_width(value_shape) -> tuple:
@@ -336,6 +377,22 @@ class PullExecutor:
                 (knob == "1" and span < graph.nv) or (knob != "0" and auto)
             ) else 0
 
+            # Source-band gathers (per-chunk lax.cond — see
+            # _src_slice_plan); LUX_SRC_SLICE=0/1 overrides the auto-on.
+            row_b = max(self._kpad or self._kreal, 1) * 4
+            span_s, src_lo, src_banded = _src_slice_plan(
+                graph.col_src, graph.ne, C, graph.nv, row_b
+            )
+            sknob = os.environ.get("LUX_SRC_SLICE", "")
+            # Traffic guard (mirrors the dst path's): each banded chunk
+            # pays ~2*span rows of slice copy to save ~C rows of
+            # big-table gather at ~5x the sub-cliff rate — only a clear
+            # win while the span stays within a couple of chunk sizes.
+            s_auto = 0 < span_s <= 2 * C
+            self._src_span = span_s if (
+                (sknob == "1" and span_s) or (sknob != "0" and s_auto)
+            ) else 0
+
             def padded(a):
                 return np.pad(a, (0, pad)).reshape(nchunks, C)
 
@@ -350,11 +407,14 @@ class PullExecutor:
                 gather_idx=put(gidx),
                 bnd_chunk=put(bchunk),
                 dst_lo=put(dst_lo),
+                src_lo=put(src_lo),
+                src_banded=put(src_banded),
                 out_degrees=put(graph.out_degrees.astype(np.int32)),
                 in_degrees=put(graph.in_degrees.astype(np.int32)),
             )
         else:
             self._dst_span = 0
+            self._src_span = 0
             eidx = _edge_index_dtype(graph.ne)
             self.dgraph = _DeviceGraph(
                 col_src=put(graph.col_src.astype(np.int32)),
@@ -417,7 +477,7 @@ class PullExecutor:
         k = self._kpad or kreal
 
         def body(_, ch):
-            cs, cd, w, bnd, dlo = ch
+            cs, cd, w, bnd, dlo, slo, sbanded = ch
             if self._dst_span:
                 # dst ids are sorted, so this chunk's dst rows live in a
                 # narrow band: gather from a small dynamic slice instead
@@ -430,8 +490,22 @@ class PullExecutor:
                 dst_vals = band[cd - dlo]
             else:
                 dst_vals = vals[cd]
+            if self._src_span:
+                # Narrow-source chunks (e.g. the item-sourced user-dst
+                # half of a bipartite ratings graph) serve src_vals from
+                # a per-chunk band too; wide chunks keep the full-table
+                # gather (per-chunk cond — see _src_slice_plan).
+                src_vals = jax.lax.cond(
+                    sbanded,
+                    lambda: jax.lax.dynamic_slice_in_dim(
+                        vals, slo, self._src_span, axis=0
+                    )[jnp.clip(cs - slo, 0, self._src_span - 1)],
+                    lambda: vals[cs],
+                )
+            else:
+                src_vals = vals[cs]
             edge = EdgeCtx(
-                src_vals=vals[cs], dst_vals=dst_vals, weights=w,
+                src_vals=src_vals, dst_vals=dst_vals, weights=w,
             )
             contrib = prog.edge_contrib(edge)
             c2 = contrib.reshape(contrib.shape[0], k)
@@ -440,14 +514,17 @@ class PullExecutor:
             return 0, (zf[bnd], z[-1])
 
         w = dg.weights
+        xs_tail = (dg.bnd_pos, dg.dst_lo, dg.src_lo, dg.src_banded)
         if w is None:
             _, (zb, totals) = jax.lax.scan(
-                lambda c, ch: body(c, (ch[0], ch[1], None, ch[2], ch[3])),
-                0, (dg.col_src, dg.seg_ids, dg.bnd_pos, dg.dst_lo),
+                lambda c, ch: body(
+                    c, (ch[0], ch[1], None) + tuple(ch[2:])
+                ),
+                0, (dg.col_src, dg.seg_ids) + xs_tail,
             )
         else:
             _, (zb, totals) = jax.lax.scan(
-                body, 0, (dg.col_src, dg.seg_ids, w, dg.bnd_pos, dg.dst_lo)
+                body, 0, (dg.col_src, dg.seg_ids, w) + xs_tail
             )
         zg = zb.reshape(-1, k)[dg.gather_idx]           # (nv+1, k)
         ph, pl = _dd_prefix(totals)                     # (nchunks+1, k)
@@ -529,6 +606,7 @@ jax.tree_util.register_dataclass(
 jax.tree_util.register_dataclass(
     _ChunkedGraph,
     data_fields=["col_src", "seg_ids", "weights", "bnd_pos", "gather_idx",
-                 "bnd_chunk", "dst_lo", "out_degrees", "in_degrees"],
+                 "bnd_chunk", "dst_lo", "src_lo", "src_banded",
+                 "out_degrees", "in_degrees"],
     meta_fields=[],
 )

@@ -97,16 +97,21 @@ def _sparse_budgets(nv: int, ne: int, queue_frac: int, edge_budget_frac: int):
     return nv // queue_frac + 128, max(ne // edge_budget_frac, 1024)
 
 
-def _blocked_candidates(x2d, relax, combiner, chunks, weighted: bool):
+def _blocked_candidates(x2d, relax, combiner, chunks, weighted: bool,
+                        ne_real=None):
     """Shared scan body of the blocked dense path: per edge, one 128-lane
     row gather from the packed (value | frontier<<31) uint32 table
     ``x2d``, lane select, unpack, relax, identity-mask. ``chunks`` is
     (sb, lane[, emask][, w]) with leading scan axes; returns the flat
-    candidate stream (padded length)."""
+    candidate stream (padded length). ``ne_real`` masks positions past
+    the real edge count to the identity without a per-edge mask array
+    (needed by block-granular consumers, which see pad positions —
+    end-pos extraction never did)."""
     iota = jnp.arange(128, dtype=jnp.int32)
     ident = identity_for(combiner, jnp.uint32)
+    C = chunks[0].shape[1]
 
-    def body(_, ch):
+    def body(base, ch):
         ch = list(ch)
         sb, lane = ch[0], ch[1]
         w = ch.pop() if weighted else None
@@ -119,10 +124,15 @@ def _blocked_candidates(x2d, relax, combiner, chunks, weighted: bool):
         active = (pk >> 31).astype(bool)
         if em is not None:
             active = active & em
+        if ne_real is not None:
+            # int32 is safe: blocked_dense is gated on ne < 2^31.
+            active = active & (
+                base + jnp.arange(C, dtype=jnp.int32) < ne_real
+            )
         cand = relax(sv, w)
-        return 0, jnp.where(active, cand, ident)
+        return base + C, jnp.where(active, cand, ident)
 
-    _, cands = jax.lax.scan(body, 0, tuple(chunks))
+    _, cands = jax.lax.scan(body, jnp.int32(0), tuple(chunks))
     return cands.reshape(-1)
 
 
@@ -263,6 +273,19 @@ class PushExecutor:
             self.queue_cap, self.edge_budget = _sparse_budgets(
                 int(graph.nv), int(graph.ne), queue_frac, edge_budget_frac
             )
+            # Size tiers (ascending): late-fixpoint frontiers of a few
+            # vertices must not pay the full ne/8-slot expansion+scatter
+            # (measured ~1 s/iter for 12 active nodes at RMAT22) — the
+            # decision picks the smallest adequate tier per iteration.
+            tiers = []
+            for div in (64, 8, 1):
+                t = (
+                    max(self.queue_cap // div, 256),
+                    max(self.edge_budget // div, 1024),
+                )
+                if t not in tiers:
+                    tiers.append(t)
+            self.tiers = tiers
             from lux_tpu.engine.pull import _edge_index_dtype
 
             csr = graph.csr()
@@ -283,6 +306,9 @@ class PushExecutor:
         # (the top bit carries the frontier), true for SSSP distances and
         # CC labels (both < nv).
         if self.blocked_dense:
+            from lux_tpu.ops.segment import BlockMinLayout
+            from lux_tpu.ops.tiled_spmv import GATHER_TABLE_BYTES
+
             C = 1 << 17
             ne = graph.ne
             pad = (-ne) % C
@@ -294,16 +320,18 @@ class PushExecutor:
                 dg["blk_w"] = put(
                     np.pad(graph.weights, (0, pad)).reshape(-1, C)
                 )
-            seg_start = np.zeros(ne, bool)
-            starts = graph.row_ptr[:-1]
-            # Trailing empty rows have start == ne; marking a clipped
-            # position would split the final real segment.
-            seg_start[starts[starts < ne]] = True
-            deg = np.diff(graph.row_ptr)
-            end_pos = np.clip(graph.row_ptr[1:] - 1, 0, max(ne - 1, 0))
-            dg["seg_start"] = put(seg_start)
-            dg["end_pos"] = put(end_pos.astype(np.int32))
-            dg["row_nonempty"] = put(deg > 0)
+            # Block-min reduction layout (one dense 128-block reduce +
+            # a 128x-smaller block-level segmented scan + masked
+            # head/tail row extraction from sub-cliff table slices) —
+            # replaces the edge-level associative min-scan whose
+            # log-depth passes dominated compTime (~4 ns/edge measured).
+            layout = BlockMinLayout(
+                graph.row_ptr, ne + pad,
+                seg_rows=GATHER_TABLE_BYTES // 512,
+            )
+            self._bm_segs = (layout.head_segs, layout.tail_segs)
+            for k, v in layout.device_arrays().items():
+                dg[k] = put(v)
         self._dg = dg
         self.sparse_iters = 0       # sparse-branch count of the last run()
         self._step = jax.jit(self._step_impl, donate_argnums=0)
@@ -357,15 +385,16 @@ class PushExecutor:
         if has_w:
             chunks = chunks + (dg["blk_w"],)
         return _blocked_candidates(
-            x2d, prog.relax, prog.combiner, chunks, has_w
+            x2d, prog.relax, prog.combiner, chunks, has_w,
+            ne_real=self.graph.ne,
         )
 
     def _bd_comp(self, cands, dg):
-        from lux_tpu.ops.segment import segment_minmax_by_rowptr
+        from lux_tpu.ops.segment import segment_minmax_blockmin
 
-        return segment_minmax_by_rowptr(
-            cands[: self.graph.ne], dg["seg_start"], dg["end_pos"],
-            dg["row_nonempty"], self.program.combiner,
+        head_segs, tail_segs = self._bm_segs
+        return segment_minmax_blockmin(
+            cands, dg, head_segs, tail_segs, self.program.combiner,
         )
 
     def _dense_iter(self, state: PushState, dg):
@@ -377,10 +406,11 @@ class PushExecutor:
 
     # -- sparse (push-direction) stages -----------------------------------
 
-    def _s_load(self, state: PushState, dg):
+    def _s_load(self, state: PushState, dg, Q=None):
         """Frontier → bounded queue (ids sorted ascending; pad slot nv)
         plus per-slot CSR ranges (padded row_ptr: q == nv → deg 0)."""
-        nv, Q = self.graph.nv, self.queue_cap
+        nv = self.graph.nv
+        Q = self.queue_cap if Q is None else Q
         q = jnp.nonzero(
             state.frontier, size=Q, fill_value=nv
         )[0].astype(jnp.int32)
@@ -389,11 +419,12 @@ class PushExecutor:
         deg = rp[jnp.minimum(q + 1, nv)] - start
         return q, start, deg
 
-    def _s_comp(self, state: PushState, q, start, deg, dg):
+    def _s_comp(self, state: PushState, q, start, deg, dg, E=None):
         prog = self.program
         nv = self.graph.nv
+        E = self.edge_budget if E is None else E
         slot, edge_pos, emask = _queue_edge_slots(
-            start, deg, self.edge_budget, max(self.graph.ne, 1)
+            start, deg, E, max(self.graph.ne, 1)
         )
         dst = dg["csr_col_dst"][edge_pos]
         src_vals = state.values[jnp.clip(q[slot], 0, nv - 1)]
@@ -412,37 +443,43 @@ class PushExecutor:
         frontier = new != state.values
         return PushState(new, frontier), frontier.sum(dtype=jnp.int32)
 
-    def _sparse_iter(self, state: PushState, dg):
-        q, start, deg = self._s_load(state, dg)
-        cand, dst = self._s_comp(state, q, start, deg, dg)
+    def _sparse_iter(self, state: PushState, dg, Q=None, E=None):
+        q, start, deg = self._s_load(state, dg, Q)
+        cand, dst = self._s_comp(state, q, start, deg, dg, E)
         return self._s_update(state, cand, dst)
 
     # -- adaptive combination --------------------------------------------
 
-    def _decide_sparse(self, state: PushState, dg):
+    def _decide_tier(self, state: PushState, dg):
+        """Branch index for lax.switch: 0 = dense; i >= 1 = self.tiers
+        [i-1] (tiers ascend in size; the SMALLEST adequate tier wins, so
+        a 12-node late-SSSP frontier runs a ~ne/512-slot expansion +
+        scatter instead of the full ne/8 budget — the static-shape
+        analogue of the reference's frontier-proportional kernel sizes,
+        sssp_gpu.cu:424-458)."""
         cnt = state.frontier.sum(dtype=jnp.int32)
-        # uint32 sum is exact for any total <= 2^32 > ne, so the sparse
-        # branch (only correct when total fits the edge budget) can never
-        # be selected by rounding error.
         out_edges = jnp.where(
             state.frontier, dg["out_degrees"].astype(jnp.uint32), 0
         ).sum(dtype=jnp.uint32)
-        return (cnt <= self.queue_cap) & (
-            out_edges <= jnp.uint32(self.edge_budget)
-        )
+        nadeq = jnp.int32(0)
+        for (Q, E) in self.tiers:
+            ok = (cnt <= Q) & (out_edges <= jnp.uint32(E))
+            nadeq = nadeq + ok.astype(jnp.int32)
+        T = len(self.tiers)
+        return jnp.where(nadeq == 0, 0, T - nadeq + 1)
 
     def _one_iter(self, state: PushState, dg):
         if not self.sparse:
             st, cnt = self._dense_iter(state, dg)
             return st, cnt, jnp.int32(0)
-        use_sparse = self._decide_sparse(state, dg)
-        st, ncnt = jax.lax.cond(
-            use_sparse,
-            lambda st: self._sparse_iter(st, dg),
-            lambda st: self._dense_iter(st, dg),
-            state,
-        )
-        return st, ncnt, use_sparse.astype(jnp.int32)
+        tier = self._decide_tier(state, dg)
+        branches = [lambda st: self._dense_iter(st, dg)]
+        for (Q, E) in self.tiers:
+            branches.append(
+                lambda st, Q=Q, E=E: self._sparse_iter(st, dg, Q, E)
+            )
+        st, ncnt = jax.lax.switch(tier, branches, state)
+        return st, ncnt, (tier > 0).astype(jnp.int32)
 
     def _step_impl(self, state: PushState, dg):
         st, cnt, _ = self._one_iter(state, dg)
@@ -472,27 +509,36 @@ class PushExecutor:
                 "update": jax.jit(self._merge_update),
             }
             if self.sparse:
-                self._jphase.update(
-                    decide=jax.jit(self._decide_sparse),
-                    s_load=jax.jit(self._s_load),
-                    s_comp=jax.jit(self._s_comp),
-                    s_update=jax.jit(self._s_update),
-                )
+                # One (s_load, s_comp) pair per size tier, so the phase
+                # breakdown measures the SAME executables run() selects
+                # (the "they cannot drift" contract).
+                self._jphase["decide"] = jax.jit(self._decide_tier)
+                for i, (Q, E) in enumerate(self.tiers):
+                    self._jphase[f"s_load{i}"] = jax.jit(
+                        lambda st, dg, Q=Q: self._s_load(st, dg, Q)
+                    )
+                    self._jphase[f"s_comp{i}"] = jax.jit(
+                        lambda st, q, s, d, dg, E=E: self._s_comp(
+                            st, q, s, d, dg, E
+                        )
+                    )
+                self._jphase["s_update"] = jax.jit(self._s_update)
         return self._jphase
 
     def warmup_phases(self, state: PushState):
-        """Compile every phase jit (both branches) outside any timed
-        region — mirrors warmup()'s contract that ELAPSED TIME excludes
-        compilation. ``state`` is read, never donated."""
+        """Compile every phase jit (all branches and tiers) outside any
+        timed region — mirrors warmup()'s contract that ELAPSED TIME
+        excludes compilation. ``state`` is read, never donated."""
         j = self._phase_jits()
         dg = self._dg
         acc = j["d_comp"](*j["d_load"](state, dg), dg)
         hard_sync(j["update"](state, acc))
         if self.sparse:
             jax.device_get(j["decide"](state, dg))
-            q, start, deg = j["s_load"](state, dg)
-            cand, dst = j["s_comp"](state, q, start, deg, dg)
-            hard_sync(j["s_update"](state, cand, dst))
+            for i in range(len(self.tiers)):
+                q, start, deg = j[f"s_load{i}"](state, dg)
+                cand, dst = j[f"s_comp{i}"](state, q, start, deg, dg)
+                hard_sync(j["s_update"](state, cand, dst))
 
     def phase_step(self, state: PushState):
         """One iteration as separately-timed load/comp/update dispatches —
@@ -506,16 +552,19 @@ class PushExecutor:
 
         j = self._phase_jits()
         dg = self._dg
-        use_sparse = bool(
+        tier = int(
             jax.device_get(j["decide"](state, dg))
-        ) if self.sparse else False
+        ) if self.sparse else 0
         times = {}
-        if use_sparse:
+        if tier > 0:
+            i = tier - 1
             with Timer() as t:
-                q, start, deg = hard_sync(j["s_load"](state, dg))
+                q, start, deg = hard_sync(j[f"s_load{i}"](state, dg))
             times["loadTime"] = t.elapsed
             with Timer() as t:
-                cand, dst = hard_sync(j["s_comp"](state, q, start, deg, dg))
+                cand, dst = hard_sync(
+                    j[f"s_comp{i}"](state, q, start, deg, dg)
+                )
             times["compTime"] = t.elapsed
             with Timer() as t:
                 new_state, cnt = hard_sync(j["s_update"](state, cand, dst))
@@ -530,7 +579,9 @@ class PushExecutor:
             with Timer() as t:
                 new_state, cnt = hard_sync(j["update"](state, acc))
             times["updateTime"] = t.elapsed
-        times["branch"] = "sparse" if use_sparse else "dense"
+        times["branch"] = (
+            f"sparse/{self.tiers[tier - 1][1]}" if tier > 0 else "dense"
+        )
         return new_state, int(jax.device_get(cnt)), times
 
     def init_state(self, **kw) -> PushState:
@@ -700,18 +751,33 @@ class ShardedPushExecutor:
             self._dg["blk_emask"] = put(chunked(self.sg.edge_mask))
             if self.sg.weights is not None:
                 self._dg["blk_w"] = put(chunked(self.sg.weights))
-            seg_start = np.zeros((P_, max_ne), bool)
-            end_pos = np.zeros((P_, self.sg.max_nv), np.int32)
-            nonempty = np.zeros((P_, self.sg.max_nv), bool)
+            # Per-shard block-min layouts, stacked. The head/tail gather
+            # tables stay unsegmented (seg_rows=0): per-part row splits
+            # are data under shard_map's one-trace model, so static
+            # segmentation is not available — same tradeoff as the
+            # sharded Z-stream; warn when a shard's table would cross
+            # the gather cliff.
+            from lux_tpu.ops.segment import BlockMinLayout
+            from lux_tpu.ops.tiled_spmv import _warn_big_table
+
+            stacked = {}
             for p in range(P_):
-                lrp = self.sg.local_row_ptr[p].astype(np.int64)
-                starts = lrp[:-1]
-                seg_start[p, starts[starts < max_ne]] = True
-                end_pos[p] = np.clip(lrp[1:] - 1, 0, max(max_ne - 1, 0))
-                nonempty[p] = np.diff(lrp) > 0
-            self._dg["seg_start"] = put(seg_start)
-            self._dg["end_pos"] = put(end_pos)
-            self._dg["row_nonempty"] = put(nonempty)
+                layout = BlockMinLayout(
+                    self.sg.local_row_ptr[p], max_ne + pad, seg_rows=0
+                )
+                for k_, v in layout.device_arrays().items():
+                    stacked.setdefault(k_, []).append(v)
+            # seg_rows=0 ⇒ one unsegmented table; derive the bounds from
+            # the (identical-across-parts) padded shapes rather than the
+            # last loop iteration's layout.
+            one = ((0, self.sg.max_nv, 0, (max_ne + pad) // 128),)
+            self._bm_segs = (one, one)
+            _warn_big_table(
+                (max_ne + pad) // 128, "sharded push block-min",
+                advice="; use more parts",
+            )
+            for k_, vs in stacked.items():
+                self._dg[k_] = put(np.stack(vs))
         else:
             self._dg["src_pidx"] = put(self.sg.src_pidx)
             self._dg["dst_local"] = put(self.sg.dst_local)
@@ -767,7 +833,7 @@ class ShardedPushExecutor:
         prog = self.program
         max_nv = self.sg.max_nv
         if self.blocked_dense:
-            from lux_tpu.ops.segment import segment_minmax_by_rowptr
+            from lux_tpu.ops.segment import segment_minmax_blockmin
 
             (x2d,) = loaded
             has_w = "blk_w" in dg
@@ -777,9 +843,10 @@ class ShardedPushExecutor:
             cands = _blocked_candidates(
                 x2d, prog.relax, prog.combiner, chunks, has_w
             )
-            acc = segment_minmax_by_rowptr(
-                cands[: self.sg.max_ne], dg["seg_start"][0],
-                dg["end_pos"][0], dg["row_nonempty"][0], prog.combiner,
+            head_segs, tail_segs = self._bm_segs
+            la = {k: v[0] for k, v in dg.items() if k.startswith("bm_")}
+            acc = segment_minmax_blockmin(
+                cands, la, head_segs, tail_segs, prog.combiner,
             )
             return acc, jnp.int32(-1)   # frontier bits ride inside cands
         all_v, all_f = loaded

@@ -118,6 +118,29 @@ def test_edge_chunked_auto_threshold(monkeypatch):
     )
 
 
+def test_edge_chunked_src_band_parity(monkeypatch):
+    # Source-band gathers (per-chunk lax.cond; the bipartite item-side
+    # src slice, PERF.md round-2 lever) must be numerically identical to
+    # full-table src gathers. Tiny chunks make user-dst chunks pure
+    # item-source (narrow band) while item-dst chunks stay wide.
+    from lux_tpu.engine.pull import _src_slice_plan
+
+    g = bipartite_ratings(seed=9)
+    monkeypatch.setenv("LUX_SRC_SLICE", "1")
+    banded = PullExecutor(g, CollaborativeFiltering(), edge_chunk=128)
+    monkeypatch.setenv("LUX_SRC_SLICE", "0")
+    full = PullExecutor(g, CollaborativeFiltering(), edge_chunk=128)
+    assert full._src_span == 0
+    np.testing.assert_array_equal(
+        np.asarray(banded.run(5)), np.asarray(full.run(5))
+    )
+    # The plan itself: at least the user-dst chunks must qualify.
+    span, src_lo, flags = _src_slice_plan(
+        g.col_src, g.ne, 128, g.nv, row_bytes=1 << 20
+    )
+    assert span == 0 or flags.any()
+
+
 def test_boundary_dense_auto_chunk_degrades(monkeypatch):
     # A graph whose rows are nearly all empty packs too many row
     # boundaries into one edge window; the AUTO path must degrade

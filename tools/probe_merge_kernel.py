@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Probe the merge-level kernel's Mosaic requirements before building
+the merge-tail network: (a) repeat-by-2 along sublanes inside a kernel
+(broadcast+reshape and jnp.repeat lowerings), (b) PrefetchScalarGridSpec
+with per-block dynamic input offsets, (c) the full 2-cand merge level at
+scale, (d) correctness vs numpy."""
+import sys, os, time, functools
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from lux_tpu.utils.platform import ensure_backend
+print("platform:", ensure_backend(), file=sys.stderr)
+from lux_tpu.engine.pull import hard_sync
+
+rng = np.random.default_rng(0)
+
+
+def k_merge(aoff_ref, boff_ref, a_ref, b_ref, i_ref, o_ref):
+    a = a_ref[...]                       # (4, 128)
+    b = b_ref[...]
+    arep = jnp.broadcast_to(a[:, None, :], (4, 2, 128)).reshape(8, 128)
+    brep = jnp.broadcast_to(b[:, None, :], (4, 2, 128)).reshape(8, 128)
+    v = i_ref[...]
+    lane = (v & 127).astype(jnp.int32)
+    ga = jnp.take_along_axis(arep, lane, axis=1)
+    gb = jnp.take_along_axis(brep, lane, axis=1)
+    o_ref[...] = jnp.where(v >= 0, ga, gb)
+
+
+def make_merge(G, R_in):
+    """G out blocks of (8,128); A/B windows of (4,128) at per-block
+    prefetched 4-row-block offsets into one (R_in,128) stream."""
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((4, 128), lambda g, aoff, boff: (aoff[g], 0)),
+            pl.BlockSpec((4, 128), lambda g, aoff, boff: (boff[g], 0)),
+            pl.BlockSpec((8, 128), lambda g, aoff, boff: (g, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda g, aoff, boff: (g, 0)),
+    )
+    return pl.pallas_call(
+        k_merge,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((G * 8, 128), jnp.float32),
+    )
+
+
+# -- correctness on a tiny case ----------------------------------------
+G = 4
+R_in = 64
+stream = rng.standard_normal((R_in, 128), dtype=np.float32)
+aoff = rng.integers(0, R_in // 4 - 1, G).astype(np.int32)
+boff = rng.integers(0, R_in // 4 - 1, G).astype(np.int32)
+idx = rng.integers(-128, 128, (G * 8, 128)).astype(np.int8)
+
+f = jax.jit(make_merge(G, R_in))
+try:
+    got = np.asarray(hard_sync(f(
+        jnp.asarray(aoff), jnp.asarray(boff),
+        jnp.asarray(stream), jnp.asarray(stream), jnp.asarray(idx),
+    )))
+except Exception as e:
+    print("merge kernel FAILED:", type(e).__name__, str(e)[:300])
+    sys.exit(1)
+
+want = np.empty_like(got)
+for g in range(G):
+    aw = stream[4 * aoff[g] : 4 * aoff[g] + 4]
+    bw = stream[4 * boff[g] : 4 * boff[g] + 4]
+    for i in range(8):
+        for j in range(128):
+            v = int(idx[8 * g + i, j])
+            lane = v & 127
+            src = aw if v >= 0 else bw
+            want[8 * g + i, j] = src[i // 2, lane]
+np.testing.assert_allclose(got, want)
+print("merge kernel CORRECT on tiny case", flush=True)
+
+# -- rate at scale ------------------------------------------------------
+G = 1 << 18          # 2M out rows = 268M slots? no: 2^18*8 rows = 2M rows
+R_in = G * 4 + 4
+stream_b = jnp.asarray(rng.standard_normal((R_in, 128), dtype=np.float32))
+aoff_b = jnp.asarray(
+    rng.integers(0, R_in // 4 - 1, G, dtype=np.int64).astype(np.int32))
+boff_b = jnp.asarray(
+    rng.integers(0, R_in // 4 - 1, G, dtype=np.int64).astype(np.int32))
+idx_b = jnp.asarray(rng.integers(-128, 128, (G * 8, 128)).astype(np.int8))
+fb = jax.jit(make_merge(G, R_in))
+M = G * 8 * 128
+
+t0 = time.perf_counter()
+hard_sync(fb(aoff_b, boff_b, stream_b, stream_b, idx_b))
+print(f"# compile+first {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+for _ in range(3):
+    t0 = time.perf_counter()
+    hard_sync(fb(aoff_b, boff_b, stream_b, stream_b, idx_b))
+    dt = time.perf_counter() - t0
+    print(f"merge level {M/1e6:.0f}M slots: {dt*1e3:.2f} ms "
+          f"({dt/M*1e9:.3f} ns/slot)", flush=True)

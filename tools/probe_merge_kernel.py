@@ -18,34 +18,34 @@ rng = np.random.default_rng(0)
 
 
 def k_merge(aoff_ref, boff_ref, a_ref, b_ref, i_ref, o_ref):
-    a = a_ref[...]                       # (4, 128)
+    a = a_ref[...]                       # (8, 128)
     b = b_ref[...]
-    arep = jnp.broadcast_to(a[:, None, :], (4, 2, 128)).reshape(8, 128)
-    brep = jnp.broadcast_to(b[:, None, :], (4, 2, 128)).reshape(8, 128)
-    v = i_ref[...]
-    lane = (v & 127).astype(jnp.int32)
+    arep = jnp.broadcast_to(a[:, None, :], (8, 2, 128)).reshape(16, 128)
+    brep = jnp.broadcast_to(b[:, None, :], (8, 2, 128)).reshape(16, 128)
+    v = i_ref[...].astype(jnp.int32)   # int8 bitwise ops don't lower
+    lane = v & 127
     ga = jnp.take_along_axis(arep, lane, axis=1)
     gb = jnp.take_along_axis(brep, lane, axis=1)
     o_ref[...] = jnp.where(v >= 0, ga, gb)
 
 
 def make_merge(G, R_in):
-    """G out blocks of (8,128); A/B windows of (4,128) at per-block
-    prefetched 4-row-block offsets into one (R_in,128) stream."""
+    """G out blocks of (16,128); A/B windows of (8,128) at per-block
+    prefetched 8-row-block offsets into one (R_in,128) stream."""
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(G,),
         in_specs=[
-            pl.BlockSpec((4, 128), lambda g, aoff, boff: (aoff[g], 0)),
-            pl.BlockSpec((4, 128), lambda g, aoff, boff: (boff[g], 0)),
-            pl.BlockSpec((8, 128), lambda g, aoff, boff: (g, 0)),
+            pl.BlockSpec((8, 128), lambda g, aoff, boff: (aoff[g], 0)),
+            pl.BlockSpec((8, 128), lambda g, aoff, boff: (boff[g], 0)),
+            pl.BlockSpec((16, 128), lambda g, aoff, boff: (g, 0)),
         ],
-        out_specs=pl.BlockSpec((8, 128), lambda g, aoff, boff: (g, 0)),
+        out_specs=pl.BlockSpec((16, 128), lambda g, aoff, boff: (g, 0)),
     )
     return pl.pallas_call(
         k_merge,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((G * 8, 128), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((G * 16, 128), jnp.float32),
     )
 
 
@@ -53,9 +53,9 @@ def make_merge(G, R_in):
 G = 4
 R_in = 64
 stream = rng.standard_normal((R_in, 128), dtype=np.float32)
-aoff = rng.integers(0, R_in // 4 - 1, G).astype(np.int32)
-boff = rng.integers(0, R_in // 4 - 1, G).astype(np.int32)
-idx = rng.integers(-128, 128, (G * 8, 128)).astype(np.int8)
+aoff = rng.integers(0, R_in // 8 - 1, G).astype(np.int32)
+boff = rng.integers(0, R_in // 8 - 1, G).astype(np.int32)
+idx = rng.integers(-128, 128, (G * 16, 128)).astype(np.int8)
 
 f = jax.jit(make_merge(G, R_in))
 try:
@@ -69,28 +69,28 @@ except Exception as e:
 
 want = np.empty_like(got)
 for g in range(G):
-    aw = stream[4 * aoff[g] : 4 * aoff[g] + 4]
-    bw = stream[4 * boff[g] : 4 * boff[g] + 4]
-    for i in range(8):
+    aw = stream[8 * aoff[g] : 8 * aoff[g] + 8]
+    bw = stream[8 * boff[g] : 8 * boff[g] + 8]
+    for i in range(16):
         for j in range(128):
-            v = int(idx[8 * g + i, j])
+            v = int(idx[16 * g + i, j])
             lane = v & 127
             src = aw if v >= 0 else bw
-            want[8 * g + i, j] = src[i // 2, lane]
+            want[16 * g + i, j] = src[i // 2, lane]
 np.testing.assert_allclose(got, want)
 print("merge kernel CORRECT on tiny case", flush=True)
 
 # -- rate at scale ------------------------------------------------------
-G = 1 << 18          # 2M out rows = 268M slots? no: 2^18*8 rows = 2M rows
-R_in = G * 4 + 4
+G = 1 << 17          # 2M out rows
+R_in = G * 8 + 8
 stream_b = jnp.asarray(rng.standard_normal((R_in, 128), dtype=np.float32))
 aoff_b = jnp.asarray(
-    rng.integers(0, R_in // 4 - 1, G, dtype=np.int64).astype(np.int32))
+    rng.integers(0, R_in // 8 - 1, G, dtype=np.int64).astype(np.int32))
 boff_b = jnp.asarray(
-    rng.integers(0, R_in // 4 - 1, G, dtype=np.int64).astype(np.int32))
-idx_b = jnp.asarray(rng.integers(-128, 128, (G * 8, 128)).astype(np.int8))
+    rng.integers(0, R_in // 8 - 1, G, dtype=np.int64).astype(np.int32))
+idx_b = jnp.asarray(rng.integers(-128, 128, (G * 16, 128)).astype(np.int8))
 fb = jax.jit(make_merge(G, R_in))
-M = G * 8 * 128
+M = G * 16 * 128
 
 t0 = time.perf_counter()
 hard_sync(fb(aoff_b, boff_b, stream_b, stream_b, idx_b))

@@ -11,6 +11,7 @@ downloadable here — BASELINE.md):
   configs (Hollywood/Indochina; real graphs cluster, R-MAT's tail does
   not) — same nv/ne as the headline graph.
 - sssp_rmat22: the push engine to fixpoint (config 3's shape).
+- cc_rmat22: Connected Components on the undirected closure (config 2).
 - cf_bipartite: NetFlix-shaped weighted bipartite SGD (config 4),
   exercising the edge-chunked engine (flat contributions exceed HBM).
 
@@ -180,18 +181,19 @@ def bench_pagerank(g, cache: str, tag: str, iters: int, layout: str,
     }
 
 
-def bench_sssp(g, max_iters: int = 12):
+def bench_push(g, program, tag: str, max_iters: int, **init_kw):
+    """Shared push-app fixpoint bench (SSSP, CC): one timing/GTEPS
+    discipline for both."""
     from lux_tpu.engine.push import PushExecutor
-    from lux_tpu.models.sssp import SSSP
 
-    ex = PushExecutor(g, SSSP())
-    ex.warmup(start=0)
+    ex = PushExecutor(g, program)
+    ex.warmup(**init_kw)
     t0 = time.perf_counter()
-    state, iters = ex.run(max_iters=max_iters, start=0)
+    state, iters = ex.run(max_iters=max_iters, **init_kw)
     elapsed = time.perf_counter() - t0
     gteps = g.ne * iters / elapsed / 1e9
     log(
-        f"sssp: {iters} iters ({ex.sparse_iters} sparse) in "
+        f"{tag}: {iters} iters ({ex.sparse_iters} sparse) in "
         f"{elapsed:.2f}s ({gteps:.3f} GTEPS)"
     )
     return {
@@ -200,6 +202,18 @@ def bench_sssp(g, max_iters: int = 12):
         "sparse_iters": ex.sparse_iters,
         "ms_per_iter": round(elapsed / max(iters, 1) * 1e3, 2),
     }
+
+
+def bench_sssp(g, max_iters: int = 12):
+    from lux_tpu.models.sssp import SSSP
+
+    return bench_push(g, SSSP(), "sssp", max_iters, start=0)
+
+
+def bench_cc(g):
+    from lux_tpu.models.components import ConnectedComponents
+
+    return bench_push(g, ConnectedComponents(), "cc", 32)
 
 
 def bench_cf(g, iters: int = 5):
@@ -329,8 +343,20 @@ def main():
             )
             return bench_cf(g_cf)
 
+        def run_cc():
+            # Connected Components runs on the undirected closure (the
+            # reference's example feeds CC an undirected graph and its
+            # max-label propagation assumes symmetry — components.py).
+            g_u = cached_graph(
+                cache, f"rmat{scale}_{ef}_undirected",
+                lambda: generate.undirected(g),
+                remaining=remaining(), gen_cost=2 * gen_cost,
+            )
+            return bench_cc(g_u)
+
         suite_item("sssp_rmat", lambda: bench_sssp(g))
         suite_item("pagerank_smallworld", run_smallworld)
+        suite_item("cc_rmat", run_cc)
         suite_item("cf_bipartite", run_cf)
         # Deadline-skipped items fall back to the most recent completed
         # measurement of the SAME code (git HEAD match), clearly labeled

@@ -1,5 +1,6 @@
 """Segment-reduction ops: blocked gather kernel + rowptr sum gate."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -42,3 +43,43 @@ def test_rowptr_sum_same_result_on_both_gate_sides(
     # The cumsum-diff reduction's absolute error scales with the prefix
     # magnitude (~eps * |running sum|), not the row's own sum.
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-5)
+
+
+def test_segment_minmax_blockmin_fuzz():
+    # The block-min hierarchy (one 128-block reduce + block-level
+    # segmented scan + masked head/tail rows) must agree with the
+    # scatter oracle for every segment shape: empty, inside-one-block,
+    # block-aligned, multi-block, trailing-empty — across both
+    # segmentation modes of the head/tail gather tables.
+    from lux_tpu.ops.segment import (
+        BlockMinLayout,
+        segment_minmax_blockmin,
+        segment_reduce,
+    )
+
+    rng = np.random.default_rng(0)
+    for trial in range(6):
+        nv = int(rng.integers(3, 400))
+        ne = int(rng.integers(0, 3000))
+        deg = rng.multinomial(ne, rng.dirichlet(np.ones(nv) * 0.3))
+        rp = np.zeros(nv + 1, np.int64)
+        np.cumsum(deg, out=rp[1:])
+        nep = -(-max(ne, 1) // 128) * 128
+        for kind in ("min", "max"):
+            data = rng.integers(0, 1 << 24, ne).astype(np.uint32)
+            ident = np.uint32(0xFFFFFFFF) if kind == "min" else np.uint32(0)
+            padded = np.full(nep, ident, np.uint32)
+            padded[:ne] = data
+            ids = np.repeat(np.arange(nv), deg)
+            want = np.asarray(segment_reduce(
+                jnp.asarray(data), jnp.asarray(ids), nv, kind
+            ))
+            for seg_rows in (0, 4):
+                lay = BlockMinLayout(rp, nep, seg_rows=seg_rows)
+                la = {k: jnp.asarray(v)
+                      for k, v in lay.device_arrays().items()}
+                got = np.asarray(segment_minmax_blockmin(
+                    jnp.asarray(padded), la, lay.head_segs,
+                    lay.tail_segs, kind,
+                ))
+                np.testing.assert_array_equal(got, want)

@@ -97,6 +97,37 @@ def _sparse_budgets(nv: int, ne: int, queue_frac: int, edge_budget_frac: int):
     return nv // queue_frac + 128, max(ne // edge_budget_frac, 1024)
 
 
+def _make_tiers(queue_cap: int, edge_budget: int):
+    """Ascending (queue, edge budget) size tiers derived from the full
+    budgets. Shared by both executors (like _sparse_budgets) so a policy
+    tweak cannot silently diverge them: per iteration the smallest
+    adequate tier serves, so a near-fixpoint frontier of a few vertices
+    does not pay the full ne/8 expansion + scatter (~1 s/iter measured
+    at RMAT22)."""
+    tiers = []
+    for div in (64, 8, 1):
+        t = (max(queue_cap // div, 256), max(edge_budget // div, 1024))
+        if t not in tiers:
+            tiers.append(t)
+    return tiers
+
+
+def _tier_index(cnt, out_edges, tiers):
+    """lax.switch branch index: 0 = dense, i >= 1 = tiers[i-1] (the
+    smallest adequate tier; adequacy is monotone in tier size, so the
+    suffix count identifies it)."""
+    nadeq = jnp.int32(0)
+    for (Q, E) in tiers:
+        ok = (cnt <= Q) & (out_edges <= jnp.uint32(E))
+        nadeq = nadeq + ok.astype(jnp.int32)
+    T = len(tiers)
+    return jnp.where(nadeq == 0, 0, T - nadeq + 1)
+
+
+def _tier_label(tiers, tier):
+    return f"sparse/{tiers[tier - 1][1]}" if tier > 0 else "dense"
+
+
 def _blocked_candidates(x2d, relax, combiner, chunks, weighted: bool,
                         ne_real=None):
     """Shared scan body of the blocked dense path: per edge, one 128-lane
@@ -273,19 +304,7 @@ class PushExecutor:
             self.queue_cap, self.edge_budget = _sparse_budgets(
                 int(graph.nv), int(graph.ne), queue_frac, edge_budget_frac
             )
-            # Size tiers (ascending): late-fixpoint frontiers of a few
-            # vertices must not pay the full ne/8-slot expansion+scatter
-            # (measured ~1 s/iter for 12 active nodes at RMAT22) — the
-            # decision picks the smallest adequate tier per iteration.
-            tiers = []
-            for div in (64, 8, 1):
-                t = (
-                    max(self.queue_cap // div, 256),
-                    max(self.edge_budget // div, 1024),
-                )
-                if t not in tiers:
-                    tiers.append(t)
-            self.tiers = tiers
+            self.tiers = _make_tiers(self.queue_cap, self.edge_budget)
             from lux_tpu.engine.pull import _edge_index_dtype
 
             csr = graph.csr()
@@ -451,22 +470,16 @@ class PushExecutor:
     # -- adaptive combination --------------------------------------------
 
     def _decide_tier(self, state: PushState, dg):
-        """Branch index for lax.switch: 0 = dense; i >= 1 = self.tiers
-        [i-1] (tiers ascend in size; the SMALLEST adequate tier wins, so
-        a 12-node late-SSSP frontier runs a ~ne/512-slot expansion +
-        scatter instead of the full ne/8 budget — the static-shape
-        analogue of the reference's frontier-proportional kernel sizes,
-        sssp_gpu.cu:424-458)."""
+        """Branch index for lax.switch — the static-shape analogue of
+        the reference's frontier-proportional kernel sizes
+        (sssp_gpu.cu:424-458); uint32 out-edge sums are exact for any
+        total <= 2^32 > ne, so a tier can never be selected past its
+        edge budget by rounding error."""
         cnt = state.frontier.sum(dtype=jnp.int32)
         out_edges = jnp.where(
             state.frontier, dg["out_degrees"].astype(jnp.uint32), 0
         ).sum(dtype=jnp.uint32)
-        nadeq = jnp.int32(0)
-        for (Q, E) in self.tiers:
-            ok = (cnt <= Q) & (out_edges <= jnp.uint32(E))
-            nadeq = nadeq + ok.astype(jnp.int32)
-        T = len(self.tiers)
-        return jnp.where(nadeq == 0, 0, T - nadeq + 1)
+        return _tier_index(cnt, out_edges, self.tiers)
 
     def _one_iter(self, state: PushState, dg):
         if not self.sparse:
@@ -579,9 +592,7 @@ class PushExecutor:
             with Timer() as t:
                 new_state, cnt = hard_sync(j["update"](state, acc))
             times["updateTime"] = t.elapsed
-        times["branch"] = (
-            f"sparse/{self.tiers[tier - 1][1]}" if tier > 0 else "dense"
-        )
+        times["branch"] = _tier_label(self.tiers, tier)
         return new_state, int(jax.device_get(cnt)), times
 
     def init_state(self, **kw) -> PushState:
@@ -788,6 +799,7 @@ class ShardedPushExecutor:
             self.queue_cap, self.edge_budget = _sparse_budgets(
                 self.sg.max_nv, self.sg.max_ne, queue_frac, edge_budget_frac
             )
+            self.tiers = _make_tiers(self.queue_cap, self.edge_budget)
             prp, pdst, pw = self.sg.build_push_csr()
             self._dg["push_row_ptr"] = put(prp)
             self._dg["push_dst_local"] = put(pdst)
@@ -890,12 +902,12 @@ class ShardedPushExecutor:
 
     # Sparse-iteration phases (same load/comp/update split).
 
-    def _sparse_load(self, state: PushState, dg):
+    def _sparse_load(self, state: PushState, dg, Q=None):
         """Local frontier → bounded queue of global ids + values, then the
         queue all-gather — the analogue of per-part frontier-chunk
         streaming (sssp_gpu.cu:424-458); O(P*Q) bytes, not O(nv)."""
         nv, max_nv = self.graph.nv, self.sg.max_nv
-        Q = self.queue_cap
+        Q = self.queue_cap if Q is None else Q
         v = state.values[0]
         f = state.frontier[0]
         q_loc = jnp.nonzero(f, size=Q, fill_value=max_nv)[0].astype(jnp.int32)
@@ -906,13 +918,13 @@ class ShardedPushExecutor:
         all_qv = jax.lax.all_gather(qv, PARTS_AXIS).reshape(-1)
         return all_q, all_qv
 
-    def _sparse_comp(self, all_q, all_qv, dg):
+    def _sparse_comp(self, all_q, all_qv, dg, E=None):
         """Expand the global queue against this shard's local edges via
         the global-src CSR (sentinel id nv reads deg == 0 — row_ptr is
         padded with two n_e entries). Returns (cand, dstl, edges)."""
         prog = self.program
         max_nv = self.sg.max_nv
-        E = self.edge_budget
+        E = self.edge_budget if E is None else E
         rp = dg["push_row_ptr"][0]
         start = rp[all_q]
         deg = rp[all_q + 1] - start
@@ -948,15 +960,19 @@ class ShardedPushExecutor:
         cnt = frontier.sum(dtype=jnp.int32)
         return PushState(new[None], frontier[None]), cnt
 
-    def _sparse_block(self, state: PushState, dg):
+    def _sparse_block(self, state: PushState, dg, Q=None, E=None):
         """One sparse iteration (fused composition of the three phases)."""
-        all_q, all_qv = self._sparse_load(state, dg)
-        cand, dstl, _ = self._sparse_comp(all_q, all_qv, dg)
+        all_q, all_qv = self._sparse_load(state, dg, Q)
+        cand, dstl, _ = self._sparse_comp(all_q, all_qv, dg, E)
         return self._sparse_update(state, cand, dstl, dg)
 
     def _decide_block(self, state: PushState, dg):
-        """Per-shard active count + the replicated sparse/dense branch
-        flag (pmax/psum collectives, so every shard agrees)."""
+        """Per-shard active count + the replicated tier index (0 = dense,
+        i >= 1 = self.tiers[i-1], smallest adequate tier). The decision
+        inputs are pmax/psum collectives, so every shard agrees: each
+        shard's expansion is bounded by the GLOBAL frontier out-edge
+        total (its local degrees sum to the global ones), so one
+        conservative test keeps all shards inside the static budgets."""
         f = state.frontier[0]
         cnt_loc = f.sum(dtype=jnp.int32)
         if not self.sparse:
@@ -966,29 +982,22 @@ class ShardedPushExecutor:
         ).sum(dtype=jnp.uint32)
         cnt_max = jax.lax.pmax(cnt_loc, PARTS_AXIS)
         oe_tot = jax.lax.psum(oe_loc, PARTS_AXIS)
-        # Every shard's expansion is bounded by the GLOBAL frontier
-        # out-edge total (its local degrees sum to the global ones), so
-        # one conservative test keeps all shards inside the static queue
-        # and edge budgets.
-        use_sparse = (cnt_max <= self.queue_cap) & (
-            oe_tot <= jnp.uint32(self.edge_budget)
-        )
-        return cnt_loc, use_sparse.astype(jnp.int32)
+        return cnt_loc, _tier_index(cnt_max, oe_tot, self.tiers)
 
     def _one_iter_block(self, state: PushState, dg):
         """Adaptive per-iteration branch; returns (state, local count,
         took_sparse)."""
-        _, use_sparse = self._decide_block(state, dg)
+        _, tier = self._decide_block(state, dg)
         if not self.sparse:
             st, cnt = self._iter_block(state, dg)
             return st, cnt, jnp.int32(0)
-        st, ncnt = jax.lax.cond(
-            use_sparse.astype(bool),
-            lambda s: self._sparse_block(s, dg),
-            lambda s: self._iter_block(s, dg),
-            state,
-        )
-        return st, ncnt, use_sparse
+        branches = [lambda s: self._iter_block(s, dg)]
+        for (Q, E) in self.tiers:
+            branches.append(
+                lambda s, Q=Q, E=E: self._sparse_block(s, dg, Q, E)
+            )
+        st, ncnt = jax.lax.switch(tier, branches, state)
+        return st, ncnt, (tier > 0).astype(jnp.int32)
 
     def _shard_step(self, state: PushState, dg):
         new_state, cnt, _ = self._one_iter_block(state, dg)
@@ -1093,17 +1102,20 @@ class ShardedPushExecutor:
             ),
         }
         if self.sparse:
-            j["s_load"] = sm(
-                lambda st, dg: self._sparse_load(st, dg),
-                (state_spec, specs), (P(), P()),
-            )
-            j["s_comp"] = sm(
-                lambda q, qv, dg: tuple(
-                    a[None] for a in self._sparse_comp(q, qv, dg)
-                ),
-                (P(), P(), specs),
-                (P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS)),
-            )
+            # One (s_load, s_comp) pair per size tier, so the phase
+            # breakdown measures the SAME executables run() selects.
+            for i, (Q, E) in enumerate(self.tiers):
+                j[f"s_load{i}"] = sm(
+                    lambda st, dg, Q=Q: self._sparse_load(st, dg, Q),
+                    (state_spec, specs), (P(), P()),
+                )
+                j[f"s_comp{i}"] = sm(
+                    lambda q, qv, dg, E=E: tuple(
+                        a[None] for a in self._sparse_comp(q, qv, dg, E)
+                    ),
+                    (P(), P(), specs),
+                    (P(PARTS_AXIS), P(PARTS_AXIS), P(PARTS_AXIS)),
+                )
             j["s_update"] = sm(
                 lambda st, cand, dstl, dg: (
                     lambda r: (r[0], r[1][None])
@@ -1127,17 +1139,18 @@ class ShardedPushExecutor:
 
         j = self._sharded_phase_jits()
         dg = self._dg
-        cnt_before, use_sparse = jax.device_get(j["decide"](state, dg))
+        cnt_before, tier = jax.device_get(j["decide"](state, dg))
         cnt_before = np.asarray(cnt_before).reshape(-1)
-        use_sparse = bool(np.asarray(use_sparse).reshape(-1)[0])
+        tier = int(np.asarray(tier).reshape(-1)[0])
         times = {}
-        if use_sparse:
+        if tier > 0:
+            i = tier - 1
             with Timer() as t:
-                all_q, all_qv = hard_sync(j["s_load"](state, dg))
+                all_q, all_qv = hard_sync(j[f"s_load{i}"](state, dg))
             times["loadTime"] = t.elapsed
             with Timer() as t:
                 cand, dstl, edges = hard_sync(
-                    j["s_comp"](all_q, all_qv, dg)
+                    j[f"s_comp{i}"](all_q, all_qv, dg)
                 )
             times["compTime"] = t.elapsed
             with Timer() as t:
@@ -1155,7 +1168,7 @@ class ShardedPushExecutor:
             with Timer() as t:
                 new_state, cnt = hard_sync(j["update"](state, acc, dg))
             times["updateTime"] = t.elapsed
-        times["branch"] = "sparse" if use_sparse else "dense"
+        times["branch"] = _tier_label(self.tiers, tier)
         edges_h = np.asarray(jax.device_get(edges)).reshape(-1)
         times["shards"] = [
             {"part": p, "activeNodes": int(cnt_before[p]),
@@ -1166,8 +1179,9 @@ class ShardedPushExecutor:
         return new_state, total, times
 
     def warmup_phases(self, state: PushState):
-        """Compile every phase executable — BOTH branches, not just the
-        one the given state would take — outside any timed region
+        """Compile every phase executable — the dense branch plus every
+        size tier, not just the branch the given state would take —
+        outside any timed region
         (mirrors the single-device warmup_phases contract; otherwise the
         first iteration on the other branch would report seconds of XLA
         compile as its phase walls). ``state`` is read, never donated."""
@@ -1178,9 +1192,10 @@ class ShardedPushExecutor:
         acc, _ = j["d_comp"](loaded, dg)
         hard_sync(j["update"](state, acc, dg))
         if self.sparse:
-            all_q, all_qv = j["s_load"](state, dg)
-            cand, dstl, _ = j["s_comp"](all_q, all_qv, dg)
-            hard_sync(j["s_update"](state, cand, dstl, dg))
+            for i in range(len(self.tiers)):
+                all_q, all_qv = j[f"s_load{i}"](state, dg)
+                cand, dstl, _ = j[f"s_comp{i}"](all_q, all_qv, dg)
+                hard_sync(j["s_update"](state, cand, dstl, dg))
 
     def run(
         self,

@@ -1,0 +1,187 @@
+"""Reference (host, unoptimized) scheduler for the merge-network tail.
+
+This is the round-4 groundwork for the source-block-grouped tail
+(PERF.md "grouped-tail / merge-network design"): a correct, executable
+specification of the routing construction, validated by simulation in
+tests/test_merge_tail.py. It is NOT wired into any executor and is not
+performance code — the real planner must vectorize the walk (34M reals
+at RMAT22) and the device side uses the probed Pallas kernels
+(tools/probe_merge_kernel.py).
+
+Model
+-----
+R runs (R a power of two; empty runs pad the tree), each a dst-sorted
+sequence of "reals". Levels ℓ = 1..L (L = log2 R) merge adjacent
+subtrees: the side of run r at level ℓ is bit ℓ-1 of r, and the node
+(subtree) containing it is r >> ℓ. One device pass per level: output
+window w (one 128-lane row) of a node reads EXACTLY input slots
+[64w, 64w+64) of each side — so a real's emission window at every
+level is forced by its slot at the level below, and all slots derive
+from its FINAL position:
+
+    slot_L(x) = f(x)                                (root output slot)
+    slot_{ℓ-1}(x) = 64 * (slot_ℓ(x) // 128) + rank of x among reals of
+                    its (node, side) within that window   (must be < 64)
+
+The construction is one forward walk over the global dst order,
+placing reals at the next final slot whose implied per-(node, side)
+window ranks all stay below 64; on overflow the final cursor advances
+to the next 128-slot row (the skipped slots are the stall pads).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+BLOCK = 128
+WIN = 64
+PAD = -1
+
+
+def _tree_size(nruns: int) -> int:
+    """Power-of-two tree width, minimum 2 so there is always at least
+    one merge level (a single run still flows through level 1 paired
+    with an empty sibling — L = 0 would schedule phantom levels with
+    no nodes)."""
+    R = 2
+    while R < nruns:
+        R *= 2
+    return R
+
+
+def schedule(runs):
+    """Assign each real a final-stream position.
+
+    ``runs``: list of dst-sorted 1-D int arrays (may be empty); length
+    is padded to a power of two internally. Returns (f, order) where
+    ``order`` indexes reals as (run, pos) pairs in global merged dst
+    order (ties by run index) and ``f[i]`` is the final slot of
+    ``order[i]``.
+    """
+    R = _tree_size(len(runs))
+    L = R.bit_length() - 1
+
+    # Global merged order: (dst, run, pos)
+    items = []
+    for r, a in enumerate(runs):
+        for p, d in enumerate(np.asarray(a)):
+            items.append((int(d), r, p))
+    items.sort()
+    n = len(items)
+
+    # Per (level, node, side) counters: rank within the current window,
+    # plus the window id the counter belongs to.
+    q = {}
+    win = {}
+    f = np.zeros(n, np.int64)
+    t = 0                     # next candidate final slot
+    for i, (_, r, p) in enumerate(items):
+        while True:
+            ok = True
+            # Derive slots top-down at candidate position t.
+            slots = {}
+            s = t
+            for lev in range(L, 0, -1):
+                node = r >> lev
+                side = (r >> (lev - 1)) & 1
+                w = s // BLOCK
+                key = (lev, node, side)
+                if win.get(key) != w:
+                    rank = 0
+                else:
+                    rank = q[key]
+                if rank >= WIN:
+                    ok = False
+                    break
+                slots[lev] = (key, w, rank)
+                s = WIN * w + rank   # slot at level lev-1's output
+            if ok:
+                break
+            t = (t // BLOCK + 1) * BLOCK   # stall: next output row
+        # Commit.
+        f[i] = t
+        for lev, (key, w, rank) in slots.items():
+            win[key] = w
+            q[key] = rank + 1
+        t += 1
+    return f, items
+
+
+def derive_level_slots(runs, f, items):
+    """Recompute every real's slot at every level from its final
+    position (the mechanical top-down derivation) and return
+    per-level dicts {(run, pos): slot}."""
+    R = _tree_size(len(runs))
+    L = R.bit_length() - 1
+    out = {lev: {} for lev in range(0, L + 1)}
+    # rank bookkeeping identical to schedule()
+    q = {}
+    win = {}
+    for i, (_, r, p) in enumerate(items):
+        s = int(f[i])
+        out[L][(r, p)] = s
+        for lev in range(L, 0, -1):
+            node = r >> lev
+            side = (r >> (lev - 1)) & 1
+            w = s // BLOCK
+            key = (lev, node, side)
+            if win.get(key) != w:
+                q[key] = 0
+                win[key] = w
+            rank = q[key]
+            q[key] = rank + 1
+            s = WIN * w + rank
+            out[lev - 1][(r, p)] = s
+    return out
+
+
+def simulate(runs, values):
+    """Execute the network in numpy with the DEVICE KERNEL's semantics
+    and return the final stream (values at final slots, zeros at pads).
+
+    ``values``: list of arrays aligned with ``runs`` (the per-real
+    contribution values). Each level is applied exactly the way the
+    pallas kernel would: output slot o of a node takes input slot
+    64*(o//128) + k of side A (k = lane code) or of side B — here
+    reconstructed from the per-level slot maps.
+    """
+    f, items = schedule(runs)
+    slots = derive_level_slots(runs, f, items)
+    R = _tree_size(len(runs))
+    L = R.bit_length() - 1
+
+    # Level-0 streams: one per leaf run (its input layout).
+    cur = {}
+    for r in range(R):
+        cur[r] = np.zeros(BLOCK, np.float64)
+    for (r, p), s in slots[0].items():
+        if s >= cur[r].shape[0]:
+            grow = ((s + BLOCK) // BLOCK) * BLOCK
+            cur[r] = np.pad(cur[r], (0, grow - cur[r].shape[0]))
+        cur[r][s] = values[r][p]
+
+    # Apply levels: node n at level ℓ merges children 2n (A) and 2n+1
+    # (B) of level ℓ-1. Every output slot reads ONE input slot of one
+    # side, within the window — emulate via the slot maps.
+    for lev in range(1, L + 1):
+        nxt = {}
+        for node in range(R >> lev):
+            nxt[node] = np.zeros(BLOCK, np.float64)
+        for (r, p), s in slots[lev].items():
+            node = r >> lev
+            side = (r >> (lev - 1)) & 1
+            s_in = slots[lev - 1][(r, p)]
+            # Kernel contract: out slot s reads side input slot s_in
+            # with 64*(s//128) <= s_in < 64*(s//128) + 64.
+            w = s // BLOCK
+            assert WIN * w <= s_in < WIN * w + WIN, (
+                "window violation", lev, r, p, s, s_in
+            )
+            child = 2 * node + side
+            v = cur[child][s_in] if s_in < cur[child].shape[0] else 0.0
+            if s >= nxt[node].shape[0]:
+                grow = ((s + BLOCK) // BLOCK) * BLOCK
+                nxt[node] = np.pad(nxt[node], (0, grow - nxt[node].shape[0]))
+            nxt[node][s] = v
+        cur = nxt
+    return cur[0], f, items

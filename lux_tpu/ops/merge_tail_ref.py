@@ -54,8 +54,8 @@ def schedule(runs):
 
     ``runs``: list of dst-sorted 1-D int arrays (may be empty); length
     is padded to a power of two internally. Returns (f, order) where
-    ``order`` indexes reals as (run, pos) pairs in global merged dst
-    order (ties by run index) and ``f[i]`` is the final slot of
+    ``order`` lists reals as (dst, run, pos) triples in global merged
+    dst order (ties by run index) and ``f[i]`` is the final slot of
     ``order[i]``.
     """
     R = _tree_size(len(runs))

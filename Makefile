@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench obs-smoke clean
 
 all: native
 
@@ -14,6 +14,9 @@ test:
 
 bench:
 	python bench.py
+
+obs-smoke:
+	python tools/obs_smoke.py
 
 clean:
 	rm -rf build ~/.cache/lux_tpu_native

@@ -22,7 +22,14 @@ import numpy as np
 
 from lux_tpu.engine.program import EdgeCtx, PullProgram, VertexCtx
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    NULL_RECORDER,
+    consume_compile_seconds,
+    note_compile_seconds,
+    recorder_for,
+)
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
+from lux_tpu.utils.timing import Timer
 
 
 def _edge_index_dtype(ne: int):
@@ -57,17 +64,25 @@ def hard_sync(x):
     return x
 
 
-def run_pipelined(step, vals, num_iters: int, flush_every: int = 8):
+def run_pipelined(step, vals, num_iters: int, flush_every: int = 8,
+                  recorder=None):
     """Launch ``num_iters`` async step waves, blocking only every
     ``flush_every`` iterations. The reference pipelines all waves and waits
     once (pagerank.cc:106-114); we additionally bound in-flight depth the
     way its push model bounds SLIDING_WINDOW, so the dispatch queue — and
-    on CPU meshes the collective rendezvous — can't grow unboundedly."""
+    on CPU meshes the collective rendezvous — can't grow unboundedly.
+
+    ``recorder`` (an obs.IterationRecorder) is flushed only at the
+    host-sync points, so disabled-mode cost is one no-op call per flush."""
+    rec = recorder if recorder is not None else NULL_RECORDER
     for i in range(num_iters):
         vals = step(vals)
         if flush_every and (i + 1) % flush_every == 0:
             jax.block_until_ready(vals)
-    return hard_sync(vals)
+            rec.flush(i + 1)
+    vals = hard_sync(vals)
+    rec.flush(num_iters)
+    return vals
 
 
 def make_fused_runner(step_fn):
@@ -89,13 +104,27 @@ def make_fused_runner(step_fn):
     return jax.jit(_run, donate_argnums=0)
 
 
-def run_maybe_fused(jrun, step, vals, num_iters: int, flush_every: int, *args):
+def run_maybe_fused(jrun, step, vals, num_iters: int, flush_every: int, *args,
+                    recorder=None):
     """Shared run() body: ``flush_every=0`` = no host syncs at all (the
     whole loop on device in one fused dispatch, dynamic trip count);
-    ``k>0`` = per-step dispatch, blocking every k iterations."""
+    ``k>0`` = per-step dispatch, blocking every k iterations.
+
+    With telemetry on, the fused path first issues a zero-trip dispatch:
+    ``jrun`` has a dynamic trip count, so n=0 compiles the same
+    executable as n=num_iters without running an iteration — that splits
+    compile time from execute time on first call. Disabled mode skips the
+    probe entirely (one predicate check, no extra dispatch)."""
+    rec = recorder if recorder is not None else NULL_RECORDER
     if flush_every == 0:
-        return hard_sync(jrun(vals, jnp.int32(num_iters), *args))
-    return run_pipelined(step, vals, num_iters, flush_every)
+        if rec.enabled:
+            with Timer() as t:
+                vals = hard_sync(jrun(vals, jnp.int32(0), *args))
+            rec.record_compile(t.elapsed)
+        vals = hard_sync(jrun(vals, jnp.int32(num_iters), *args))
+        rec.flush(num_iters)
+        return vals
+    return run_pipelined(step, vals, num_iters, flush_every, recorder=rec)
 
 
 @dataclasses.dataclass
@@ -573,27 +602,40 @@ class PullExecutor:
         region (the reference's kernels are compiled at build time, so its
         ELAPSED TIME never includes compilation; hard_sync also primes the
         transfer path on tunneled backends)."""
-        hard_sync(self.step(self.init_values()))
+        with Timer() as t:
+            hard_sync(self.step(self.init_values()))
+        note_compile_seconds(self, t.elapsed)
 
     def run(
         self,
         num_iters: int,
         vals: Optional[jnp.ndarray] = None,
         flush_every: int = 8,
+        recorder=None,
     ):
         if vals is None:
             vals = self.init_values()
+        rec = recorder if recorder is not None else recorder_for(
+            "pull", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
         if self._kpad:
             padded = run_maybe_fused(
                 self._jrun,
                 lambda v: self._step(v, self.dgraph),
                 self._lane_pad(jnp.asarray(vals)),
                 num_iters, flush_every, self.dgraph,
+                recorder=rec,
             )
-            return hard_sync(padded[:, : self._kreal])
-        return run_maybe_fused(
-            self._jrun, self.step, vals, num_iters, flush_every, self.dgraph
-        )
+            out = hard_sync(padded[:, : self._kreal])
+        else:
+            out = run_maybe_fused(
+                self._jrun, self.step, vals, num_iters, flush_every,
+                self.dgraph, recorder=rec,
+            )
+        rec.finish()
+        return out
 
 
 jax.tree_util.register_dataclass(

@@ -29,6 +29,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from lux_tpu.engine.program import EdgeCtx, PullProgram, VertexCtx
 from lux_tpu.engine.pull import hard_sync, make_fused_runner, run_maybe_fused
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    consume_compile_seconds,
+    note_compile_seconds,
+    recorder_for,
+)
+from lux_tpu.utils.timing import Timer
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
 from lux_tpu.parallel.shard import ShardedGraph
@@ -210,8 +216,6 @@ class ShardedPullExecutor:
         mesh-lockstep, so the walls are mesh-wide. Returns (new vals,
         {phase: seconds}). Phase dispatch breaks fusion; use run() for
         timed loops."""
-        from lux_tpu.utils.timing import Timer
-
         if not hasattr(self, "_pjits"):
             specs = {k: P(PARTS_AXIS) for k in self._device_graph}
 
@@ -251,15 +255,40 @@ class ShardedPullExecutor:
         return new, times
 
     def warmup(self):
-        hard_sync(self.step(self.init_values()))
+        with Timer() as t:
+            hard_sync(self.step(self.init_values()))
+        note_compile_seconds(self, t.elapsed)
 
-    def run(self, num_iters: int, vals=None, flush_every: int = 8):
+    def _exchange_bytes_per_iter(self) -> int:
+        """ICI bytes moved by one iteration's all-gather: each of the P
+        shards sends its (max_nv, kreal-or-scalar) slice to the P-1
+        others (``_exchange_block`` gathers only real lanes when
+        lane-padded)."""
+        try:
+            itemsize = np.dtype(self.program.value_dtype).itemsize
+        except (AttributeError, TypeError):
+            itemsize = 4
+        width = max(self._kreal, 1)
+        p = self.num_parts
+        return p * (p - 1) * self.sg.max_nv * width * itemsize
+
+    def run(self, num_iters: int, vals=None, flush_every: int = 8,
+            recorder=None):
         if vals is None:
             vals = self.init_values()
-        return run_maybe_fused(
+        rec = recorder if recorder is not None else recorder_for(
+            "pull_sharded", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            rec.set_exchange_bytes(
+                self._exchange_bytes_per_iter(), note="all_gather")
+        out = run_maybe_fused(
             self._jrun, self.step, vals, num_iters, flush_every,
-            self._device_graph,
+            self._device_graph, recorder=rec,
         )
+        rec.finish()
+        return out
 
     def gather_values(self, vals) -> np.ndarray:
         """Padded device layout → global (nv, *t) host array."""

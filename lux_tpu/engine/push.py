@@ -47,9 +47,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from lux_tpu.engine.pull import hard_sync
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    NULL_RECORDER,
+    consume_compile_seconds,
+    note_compile_seconds,
+    recorder_for,
+)
 from lux_tpu.ops.segment import identity_for, segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
 from lux_tpu.parallel.shard import ShardedGraph
+from lux_tpu.utils.timing import Timer
 
 class PushProgram:
     """Frontier-driven vertex program (SSSP, CC, ...)."""
@@ -614,6 +621,7 @@ class PushExecutor:
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
         chunk: int = 16,
+        recorder=None,
         **init_kw,
     ):
         """Iterate to fixpoint; returns (final_state, iterations_run).
@@ -624,9 +632,15 @@ class PushExecutor:
         ``self.sparse_iters`` after each run."""
         if state is None:
             state = self.init_state(**init_kw)
+        rec = recorder if recorder is not None else recorder_for(
+            "push", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
         state, total, self.sparse_iters = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk
+            self._multi, state, max_iters, chunk, recorder=rec
         )
+        rec.finish()
         return state, total
 
     def _multi(self, state: PushState, limit: int, k: int):
@@ -636,10 +650,13 @@ class PushExecutor:
         """Run one throwaway iteration through the exact run() path so
         ELAPSED TIME excludes XLA compilation AND first-transfer setup
         (both disproportionately slow on tunneled backends)."""
-        _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
+        with Timer() as t:
+            _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
+        note_compile_seconds(self, t.elapsed)
 
 
-def _run_to_fixpoint(multi, state, max_iters, chunk):
+def _run_to_fixpoint(multi, state, max_iters, chunk, recorder=None):
+    rec = recorder if recorder is not None else NULL_RECORDER
     total = 0
     sparse_total = 0
     while True:
@@ -658,9 +675,14 @@ def _run_to_fixpoint(multi, state, max_iters, chunk):
         fl = np.asarray(flags_h).reshape(-1, k)[0][:done_i]
         sparse_total += int(fl.sum())
         total += done_i
+        # counts is (k,) single-device or psum-replicated (P, k) sharded;
+        # row 0 is the global post-step active count either way.
+        cnts = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
+        rec.flush(total, frontier_sizes=cnts)
         if last_i == 0 or done_i == 0:
             break
     hard_sync(state.values)
+    rec.flush(total)
     return state, total, sparse_total
 
 
@@ -1202,17 +1224,33 @@ class ShardedPushExecutor:
         max_iters: Optional[int] = None,
         state: Optional[PushState] = None,
         chunk: int = 16,
+        recorder=None,
         **init_kw,
     ):
         if state is None:
             state = self.init_state(**init_kw)
+        rec = recorder if recorder is not None else recorder_for(
+            "push_sharded", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            # Dense-branch upper bound: each part broadcasts its candidate
+            # table (max_nv values @4B + 1B flag) to the P-1 others. The
+            # sparse branch moves less; per-branch accounting would need
+            # device readbacks the fixpoint loop doesn't do.
+            p = self.num_parts
+            rec.set_exchange_bytes(
+                p * (p - 1) * self.sg.max_nv * 5, note="dense_estimate")
         state, total, self.sparse_iters = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk
+            self._multi, state, max_iters, chunk, recorder=rec
         )
+        rec.finish()
         return state, total
 
     def warmup(self, chunk: int = 16, **init_kw):
-        _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
+        with Timer() as t:
+            _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
+        note_compile_seconds(self, t.elapsed)
 
     def gather_values(self, state: PushState) -> np.ndarray:
         return self.sg.from_padded(np.asarray(jax.device_get(state.values)))

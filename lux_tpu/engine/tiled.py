@@ -28,6 +28,13 @@ from lux_tpu.engine.pull import (
     run_maybe_fused,
 )
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    NULL_RECORDER,
+    consume_compile_seconds,
+    note_compile_seconds,
+    recorder_for,
+)
+from lux_tpu.utils.timing import Timer
 from lux_tpu.ops.tiled_spmv import (
     DEFAULT_CHUNK_STRIPS,
     DEFAULT_CHUNK_TAIL,
@@ -250,7 +257,6 @@ class TiledPullExecutor:
         (new external vals, {phase: seconds}). Phase dispatch breaks
         XLA's cross-phase fusion, so the sum runs slower than step()."""
         from lux_tpu.ops.tiled_spmv import strips_sum, tail_sum, vals_to_x2d
-        from lux_tpu.utils.timing import Timer
 
         if not hasattr(self, "_jphase"):
             nv = self.graph.nv
@@ -289,20 +295,33 @@ class TiledPullExecutor:
     def warmup(self):
         """Compile the step and both permutation converters (run(1) with
         explicit vals exercises every jitted path run() can take)."""
-        hard_sync(self.run(1, vals=self.init_values()))
+        with Timer() as t:
+            # NULL_RECORDER: the throwaway iteration must not write a
+            # telemetry report of its own.
+            hard_sync(self.run(1, vals=self.init_values(),
+                               recorder=NULL_RECORDER))
+        note_compile_seconds(self, t.elapsed)
 
     def run(
         self,
         num_iters: int,
         vals: Optional[jnp.ndarray] = None,
         flush_every: int = 8,
+        recorder=None,
     ):
         if vals is None:
             internal = self._init_internal()
         else:
             internal = self._to_internal(jnp.asarray(vals), self.order)
+        rec = recorder if recorder is not None else recorder_for(
+            "tiled", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
         internal = run_maybe_fused(
             self._jrun, self._step, internal, num_iters, flush_every,
-            *self._step_args,
+            *self._step_args, recorder=rec,
         )
-        return hard_sync(self._to_external(internal, self.rank))
+        out = hard_sync(self._to_external(internal, self.rank))
+        rec.finish()
+        return out

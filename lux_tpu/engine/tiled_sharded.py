@@ -48,6 +48,12 @@ from lux_tpu.engine.pull import (
 )
 from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    consume_compile_seconds,
+    note_compile_seconds,
+    recorder_for,
+)
+from lux_tpu.utils.timing import Timer
 from lux_tpu.ops.tiled_spmv import (
     BLOCK,
     DEFAULT_CHUNK_STRIPS,
@@ -536,8 +542,6 @@ class ShardedTiledExecutor:
         breakdown, sssp/sssp_gpu.cu:516-518). SPMD phases are
         mesh-lockstep, so the walls are mesh-wide. Returns (new vals,
         {phase: seconds})."""
-        from lux_tpu.utils.timing import Timer
-
         if not hasattr(self, "_pjits"):
             specs = {k: P(PARTS_AXIS) for k in self._shard_args}
 
@@ -585,15 +589,34 @@ class ShardedTiledExecutor:
         return new, times
 
     def warmup(self):
-        hard_sync(self.step(self.init_values()))
+        with Timer() as t:
+            hard_sync(self.step(self.init_values()))
+        note_compile_seconds(self, t.elapsed)
 
-    def run(self, num_iters: int, vals=None, flush_every: int = 8):
+    def _exchange_bytes_per_iter(self, vals) -> int:
+        """ICI bytes for one iteration's all-gather of the (P, max_nv)
+        value stack: each part sends its shard to the P-1 others."""
+        shard_elems = int(np.prod(vals.shape[1:])) if vals.ndim > 1 else 1
+        p = self.num_parts
+        return p * (p - 1) * shard_elems * vals.dtype.itemsize
+
+    def run(self, num_iters: int, vals=None, flush_every: int = 8,
+            recorder=None):
         if vals is None:
             vals = self.init_values()
-        return run_maybe_fused(
+        rec = recorder if recorder is not None else recorder_for(
+            "tiled_sharded", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            rec.set_exchange_bytes(
+                self._exchange_bytes_per_iter(vals), note="all_gather")
+        out = run_maybe_fused(
             self._jrun, self._step, vals, num_iters, flush_every,
-            self._shard_args, self._replicated,
+            self._shard_args, self._replicated, recorder=rec,
         )
+        rec.finish()
+        return out
 
     def gather_values(self, vals) -> np.ndarray:
         """Sharded padded internal layout -> global EXTERNAL (nv,) array."""

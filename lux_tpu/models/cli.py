@@ -15,12 +15,14 @@ checkpointing, ``-profile DIR`` (jax.profiler trace).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from contextlib import nullcontext
 from typing import Optional
 
 import numpy as np
 
+from lux_tpu import obs
 from lux_tpu.utils.logging import get_logger
 from lux_tpu.utils.timing import Timer
 
@@ -76,7 +78,28 @@ def build_parser(name: str, push: bool) -> argparse.ArgumentParser:
     p.add_argument("-save", help="write checkpoint npz after the run")
     p.add_argument("-resume", help="resume vertex state from checkpoint npz")
     p.add_argument("-profile", help="capture a jax.profiler trace to DIR")
+    p.add_argument(
+        "-metrics", "--metrics", dest="metrics",
+        help="append the run's telemetry (per-iteration records, "
+        "compile/execute split) as one JSON line to PATH "
+        "(equivalent to LUX_METRICS=PATH)",
+    )
+    p.add_argument(
+        "-trace", "--trace", dest="trace",
+        help="stream Chrome trace_event JSON-lines to PATH for Perfetto "
+        "(equivalent to LUX_TRACE=PATH)",
+    )
     return p
+
+
+def setup_telemetry(args):
+    """Map the -metrics/-trace flags onto the LUX_* env vars the obs
+    subsystem is gated by, then re-read them."""
+    if getattr(args, "metrics", None):
+        os.environ["LUX_METRICS"] = args.metrics
+    if getattr(args, "trace", None):
+        os.environ["LUX_TRACE"] = args.trace
+    obs.reconfigure()
 
 
 def load_graph(path: str, program, log):
@@ -226,10 +249,11 @@ def final_values(ex, result) -> np.ndarray:
 
 def print_gteps(g, iters: int, elapsed: float):
     if elapsed > 0 and iters > 0:
-        gteps = g.ne * iters / elapsed / 1e9
+        # obs.gteps is THE definition (edges traversed / iteration time);
+        # bench.py and every engine report through the same helper.
         print(
-            f"GTEPS = {gteps:.4f} ({iters} iters x {g.ne} edges "
-            f"/ {elapsed:.4f}s)"
+            f"GTEPS = {obs.gteps(g.ne, iters, elapsed):.4f} "
+            f"({iters} iters x {g.ne} edges / {elapsed:.4f}s)"
         )
 
 
@@ -238,6 +262,7 @@ def run_pull_app(program, argv, oracle=None):
     ``-check`` (the reference has no pull-side checker; we add one)."""
     log = get_logger(program.name)
     args = build_parser(program.name, push=False).parse_args(argv)
+    setup_telemetry(args)
     g = load_graph(args.file, program, log)
     if program.needs_weights and g.weights is None:
         print(f"error: {program.name} needs a weighted graph", file=sys.stderr)
@@ -282,6 +307,12 @@ def run_pull_app(program, argv, oracle=None):
                 # phase dispatches are separate executables from the
                 # fused step that warmup() compiled).
                 ex.phase_step(vals)
+            # The verbose loop bypasses ex.run(), so it drives its own
+            # recorder; every iteration is already host-synced here.
+            rec = obs.recorder_for(obs.engine_label(ex), g, program)
+            rec.start()
+            if rec.enabled:
+                rec.record_compile(obs.consume_compile_seconds(ex))
             with Timer() as t:
                 for i in range(remaining):
                     if has_phases:
@@ -300,6 +331,8 @@ def run_pull_app(program, argv, oracle=None):
                         print(
                             f"iter {start_iter + i}: {ti.elapsed*1e3:.3f} ms"
                         )
+                    rec.flush(i + 1)
+            rec.finish()
         else:
             with Timer() as t:
                 vals = ex.run(remaining, vals=vals)
@@ -390,6 +423,12 @@ def _run_push_verbose(ex, state, max_iters, start_iter, init_kw):
     # chunk executable; the phase jits are separate executables). The
     # throwaway state absorbs any donation.
     ex.warmup_phases(ex.init_state(**init_kw))
+    # The verbose loop bypasses ex.run(), so it drives its own recorder;
+    # phase_step syncs every iteration.
+    rec = obs.recorder_for(obs.engine_label(ex), ex.graph, ex.program)
+    rec.start()
+    if rec.enabled:
+        rec.record_compile(obs.consume_compile_seconds(ex))
     with Timer() as t:
         while max_iters is None or iters < max_iters:
             state, cnt, ph = ex.phase_step(state)
@@ -410,8 +449,10 @@ def _run_push_verbose(ex, state, max_iters, start_iter, init_kw):
             )
             total = cnt
             iters += 1
+            rec.flush(iters, frontier_sizes=[cnt])
             if total == 0:
                 break
+    rec.finish()
     return state, iters, t
 
 
@@ -420,6 +461,7 @@ def run_push_app(program, argv, supports_start: bool):
 
     log = get_logger(program.name)
     args = build_parser(program.name, push=True).parse_args(argv)
+    setup_telemetry(args)
     g = load_graph(args.file, program, log)
     memory_advisory(g, args.parts, 4, push=True)
     ex = make_executor(g, program, args)

@@ -1,0 +1,41 @@
+"""Unified telemetry: metrics registry, Chrome-trace spans, per-iteration
+run records, and end-of-run reports.
+
+Environment knobs (all optional; everything is a no-op when unset):
+
+- ``LUX_METRICS=<path>`` — append one JSON line per run: the
+  ``lux.run_telemetry.v1`` summary with per-iteration records and a
+  metrics-registry snapshot.
+- ``LUX_TRACE=<path>`` — stream Chrome trace_event JSON-lines
+  (Perfetto-loadable via ``tools/trace_summary.py --to-chrome``).
+- ``LUX_LOG=<level>`` — log level for the ``lux.*`` categories,
+  including the ``lux.perf`` run-report table.
+"""
+
+from ..utils import logging as _logging
+from . import metrics, report, trace
+from .iterlog import (
+    NULL_RECORDER,
+    IterationRecorder,
+    consume_compile_seconds,
+    engine_label,
+    gteps,
+    note_compile_seconds,
+    recorder_for,
+    telemetry_enabled,
+)
+
+__all__ = [
+    "metrics", "trace", "report",
+    "IterationRecorder", "NULL_RECORDER", "recorder_for",
+    "telemetry_enabled", "gteps", "engine_label",
+    "note_compile_seconds", "consume_compile_seconds",
+    "reconfigure",
+]
+
+
+def reconfigure():
+    """Re-read LUX_TRACE and LUX_LOG after the environment changed
+    (CLI flags set env vars post-import)."""
+    trace.reconfigure()
+    _logging.reconfigure()

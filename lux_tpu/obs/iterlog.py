@@ -1,0 +1,231 @@
+"""Per-iteration run telemetry: the ``IterationRecorder`` hook.
+
+Every executor ``run()`` drives one recorder. The contract that keeps
+XLA fusion intact: engines call ``flush(iters_done)`` only at points
+where the host has already synced (after ``block_until_ready`` in
+``run_pipelined``, after the chunk ``device_get`` in the push fixpoint,
+after the final ``hard_sync`` of a fused dispatch) — the recorder itself
+never touches device values. Within a fused ``fori_loop`` there is
+nothing to observe per iteration, so a flush window spanning n
+iterations amortizes its wall time over those n records.
+
+When neither ``LUX_METRICS`` nor ``LUX_TRACE`` is set,
+``recorder_for()`` returns the shared ``NULL_RECORDER`` whose every
+method is a no-op — one predicate check per *flush*, not per iteration,
+is the total disabled-mode overhead.
+
+GTEPS is defined here, once, for every engine and for bench.py:
+edges traversed / iteration time (``gteps()``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from . import metrics, trace
+
+
+def gteps(ne: int, iters: int, seconds: float) -> float:
+    """Traversed-edges-per-second in units of 1e9: ``ne`` edges visited
+    per iteration, ``iters`` iterations, over ``seconds`` of iteration
+    (execute) time. The single GTEPS definition for all engines."""
+    if seconds <= 0 or iters <= 0:
+        return 0.0
+    return ne * iters / seconds / 1e9
+
+
+class _NullRecorder:
+    """Disabled-mode recorder: every hook is a constant no-op."""
+
+    enabled = False
+
+    def start(self):
+        return self
+
+    def record_compile(self, seconds):
+        pass
+
+    def flush(self, iters_done, frontier_sizes=None, active_edges=None,
+              residual=None):
+        pass
+
+    def set_exchange_bytes(self, per_iter, note=None):
+        pass
+
+    def finish(self):
+        return None
+
+    def summary(self):
+        return None
+
+
+NULL_RECORDER = _NullRecorder()
+
+
+def telemetry_enabled() -> bool:
+    return bool(os.environ.get("LUX_METRICS")) or trace.enabled()
+
+
+def recorder_for(engine: str, graph, program=None):
+    """Recorder for one ``run()`` call: a live ``IterationRecorder`` when
+    telemetry is on, else the shared no-op ``NULL_RECORDER``."""
+    if not telemetry_enabled():
+        return NULL_RECORDER
+    prog = type(program).__name__ if program is not None else ""
+    return IterationRecorder(
+        engine, int(graph.nv), int(graph.ne), program=prog,
+    )
+
+
+def engine_label(ex) -> str:
+    """Short engine name for an executor instance (telemetry labels)."""
+    name = type(ex).__name__
+    return {
+        "PullExecutor": "pull",
+        "TiledPullExecutor": "tiled",
+        "ShardedPullExecutor": "pull_sharded",
+        "ShardedTiledExecutor": "tiled_sharded",
+        "PushExecutor": "push",
+        "ShardedPushExecutor": "push_sharded",
+    }.get(name, name.lower())
+
+
+def note_compile_seconds(ex, seconds: float):
+    """Stash warmup/compile seconds on an executor so the next ``run()``
+    can report them (warmup happens before the recorder exists)."""
+    ex._obs_compile_s = getattr(ex, "_obs_compile_s", 0.0) + float(seconds)
+
+
+def consume_compile_seconds(ex) -> float:
+    s = getattr(ex, "_obs_compile_s", 0.0)
+    ex._obs_compile_s = 0.0
+    return s
+
+
+class IterationRecorder:
+    """Accumulates per-iteration records for one run; emits trace spans
+    and metrics at flush granularity; hands the summary to report.py."""
+
+    enabled = True
+
+    def __init__(self, engine: str, nv: int, ne: int, program: str = ""):
+        self.engine = engine
+        self.nv = nv
+        self.ne = ne
+        self.program = program
+        self.compile_s = 0.0
+        self.execute_s = 0.0
+        self.exchange_bytes_per_iter = 0
+        self.exchange_note = None
+        self.iterations = []
+        self._iters = 0
+        self._flushes = 0
+        self._t0 = None
+        self._t_last = None
+        self._finished = False
+
+    def start(self):
+        self._t0 = self._t_last = time.perf_counter()
+        trace.begin(f"{self.engine}.run", cat="run",
+                    args={"program": self.program, "nv": self.nv,
+                          "ne": self.ne})
+        return self
+
+    def record_compile(self, seconds):
+        """Credit compile/warmup time, kept out of every flush window."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            return
+        now = time.perf_counter()
+        if self._t_last is not None and now - seconds >= self._t0:
+            trace.pair(f"{self.engine}.compile", now - seconds, now,
+                       cat="compile")
+        self.compile_s += seconds
+        if self._t_last is not None:
+            self._t_last = now
+        metrics.histogram(
+            "lux_compile_seconds", {"engine": self.engine},
+        ).observe(seconds)
+
+    def set_exchange_bytes(self, per_iter, note=None):
+        self.exchange_bytes_per_iter = int(per_iter)
+        self.exchange_note = note
+        metrics.gauge(
+            "lux_exchange_bytes_per_iter", {"engine": self.engine},
+        ).set(per_iter)
+
+    def flush(self, iters_done, frontier_sizes=None, active_edges=None,
+              residual=None):
+        """Record the window since the previous flush. Call only right
+        after a host sync; ``iters_done`` is the cumulative iteration
+        count for the run so far."""
+        iters_done = int(iters_done)
+        n = iters_done - self._iters
+        if n <= 0:
+            return
+        now = time.perf_counter()
+        dt = now - self._t_last
+        self._t_last = now
+        self.execute_s += dt
+        self._flushes += 1
+        per = dt / n
+        for j in range(n):
+            it = self._iters + j
+            frontier = None
+            if frontier_sizes is not None and j < len(frontier_sizes):
+                frontier = int(frontier_sizes[j])
+            ae = int(active_edges) if active_edges is not None else self.ne
+            rec = {
+                "iter": it,
+                "t_iter_s": per,
+                "t_cum_s": self.execute_s - dt + per * (j + 1),
+                "flush_span": self._flushes,
+                "active_edges": ae,
+                "gteps": gteps(ae, 1, per),
+            }
+            if frontier is not None:
+                rec["frontier"] = frontier
+            if residual is not None and j == n - 1:
+                rec["residual"] = float(residual)
+            self.iterations.append(rec)
+        self._iters = iters_done
+        trace.pair(f"{self.engine}.flush", now - dt, now, cat="execute",
+                   args={"iters": n, "iters_done": iters_done})
+        metrics.counter(
+            "lux_iterations_total", {"engine": self.engine},
+        ).inc(n)
+        metrics.histogram(
+            "lux_iteration_seconds", {"engine": self.engine},
+        ).observe(per)
+
+    def summary(self) -> dict:
+        return {
+            "schema": "lux.run_telemetry.v1",
+            "engine": self.engine,
+            "program": self.program,
+            "nv": self.nv,
+            "ne": self.ne,
+            "num_iters": self._iters,
+            "compile_s": self.compile_s,
+            "execute_s": self.execute_s,
+            "gteps": gteps(self.ne, self._iters, self.execute_s),
+            "exchange_bytes_per_iter": self.exchange_bytes_per_iter,
+            "exchange_bytes_total": self.exchange_bytes_per_iter * self._iters,
+            "iterations": self.iterations,
+        }
+
+    def finish(self) -> dict:
+        """Close the run span and publish the report; idempotent."""
+        if self._finished:
+            return self.summary()
+        self._finished = True
+        trace.end(f"{self.engine}.run", cat="run")
+        summary = self.summary()
+        if self.exchange_bytes_per_iter:
+            metrics.counter(
+                "lux_exchange_bytes_total", {"engine": self.engine},
+            ).inc(summary["exchange_bytes_total"])
+        from . import report
+        report.finalize(summary)
+        return summary

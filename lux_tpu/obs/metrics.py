@@ -1,0 +1,180 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The reference has no metrics layer at all — its only instrumentation is
+the wall-clock bracket around the iteration loop (pagerank.cc:108-118).
+This registry follows the Prometheus client data model (dependency-free:
+the container bakes nothing beyond the jax toolchain) so the run report
+(obs/report.py) can dump every counter the engines touched alongside the
+per-iteration log.
+
+Identity semantics: a metric is keyed by ``(name, sorted(labels))``;
+requesting the same key twice returns the SAME object (label dedup), and
+re-requesting a name under a different metric kind raises — silent kind
+drift is how counters get overwritten by gauges in long-lived processes.
+
+Everything here is plain Python on the host; nothing imports jax. The
+engines only touch the registry at flush granularity (obs/iterlog.py), so
+cost is irrelevant to fused device loops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+# Histogram bucket upper bounds (seconds-oriented: compile and iteration
+# walls span ~100us CPU-test steps to minutes-long remote compiles).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, float("inf"),
+)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+class Counter:
+    """Monotonically increasing count (iterations run, flushes, bytes)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        self.value += amount
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "labels": self.labels,
+            "value": self.value,
+        }
+
+
+class Gauge:
+    """Point-in-time value (exchange bytes per iteration, frontier size)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, value: float):
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        self.value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.value -= amount
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "labels": self.labels,
+            "value": self.value,
+        }
+
+
+class Histogram:
+    """Distribution of observations (per-iteration seconds, compile
+    seconds) as cumulative bucket counts plus count/sum."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        labels: Dict[str, str],
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        bounds = tuple(sorted(buckets))
+        if not bounds or bounds[-1] != float("inf"):
+            bounds = bounds + (float("inf"),)
+        self.name = name
+        self.labels = labels
+        self.bounds = bounds
+        self.bucket_counts = [0] * len(bounds)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float):
+        self.count += 1
+        self.sum += value
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                self.bucket_counts[i] += 1
+                break
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "labels": self.labels,
+            "count": self.count, "sum": self.sum,
+            "buckets": [
+                # inf serializes as a string: json.dumps(float('inf'))
+                # emits the non-standard literal `Infinity`.
+                {"le": b if b != float("inf") else "+Inf", "count": c}
+                for b, c in zip(self.bounds, self.bucket_counts)
+            ],
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe metric store; one per process (module-level REGISTRY)."""
+
+    def __init__(self):
+        self._metrics: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: Optional[Dict[str, str]], **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, dict(labels or {}), **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, labels=None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, labels=None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, labels=None, buckets=DEFAULT_BUCKETS):
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def snapshot(self) -> list:
+        """JSON-ready dump of every registered metric, sorted by name so
+        dumps diff cleanly across runs."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return sorted(
+            (m.snapshot() for m in metrics),
+            key=lambda s: (s["name"], sorted(s["labels"].items())),
+        )
+
+    def reset(self):
+        """Drop every metric (tests; a fresh process needs nothing)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+# Module-level conveniences bound to the process registry.
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+snapshot = REGISTRY.snapshot
+reset = REGISTRY.reset

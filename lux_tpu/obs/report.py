@@ -1,0 +1,84 @@
+"""End-of-run reporting: ``lux.perf`` log table + ``LUX_METRICS`` dump.
+
+``finalize(summary)`` is called by ``IterationRecorder.finish()`` with
+the ``lux.run_telemetry.v1`` summary dict. It renders a compact table to
+the ``lux.perf`` logger and, when ``LUX_METRICS=<path>`` is set, appends
+one JSON line (the summary plus a metrics-registry snapshot) to that
+path. JSON-lines append means warmup-free repeated runs in one process
+coexist; readers take the last line for the headline run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..utils.logging import get_logger
+from . import metrics
+
+# Cap the per-iteration rows logged to lux.perf; the JSON dump always
+# carries every record.
+_LOG_ROWS_HEAD = 24
+_LOG_ROWS_TAIL = 8
+
+
+def _format_table(summary: dict) -> str:
+    lines = [
+        "run report: engine={engine} program={program} nv={nv} ne={ne}".format(
+            **summary),
+        "  iters={num_iters} compile={compile_s:.4f}s "
+        "execute={execute_s:.4f}s gteps={gteps:.4f}".format(**summary),
+    ]
+    if summary.get("exchange_bytes_per_iter"):
+        lines.append(
+            "  exchange: {exchange_bytes_per_iter} B/iter, "
+            "{exchange_bytes_total} B total".format(**summary))
+    rows = summary.get("iterations") or []
+    if rows:
+        lines.append(
+            "  {:>6} {:>12} {:>12} {:>10} {:>9}".format(
+                "iter", "t_iter_s", "t_cum_s", "frontier", "gteps"))
+        shown = rows
+        elided = 0
+        if len(rows) > _LOG_ROWS_HEAD + _LOG_ROWS_TAIL:
+            shown = rows[:_LOG_ROWS_HEAD]
+            elided = len(rows) - _LOG_ROWS_HEAD - _LOG_ROWS_TAIL
+        for r in shown:
+            lines.append(_format_row(r))
+        if elided:
+            lines.append(f"  ... {elided} rows elided ...")
+            for r in rows[-_LOG_ROWS_TAIL:]:
+                lines.append(_format_row(r))
+    return "\n".join(lines)
+
+
+def _format_row(r: dict) -> str:
+    frontier = r.get("frontier")
+    return "  {:>6} {:>12.6f} {:>12.6f} {:>10} {:>9.4f}".format(
+        r["iter"], r["t_iter_s"], r["t_cum_s"],
+        "-" if frontier is None else frontier, r["gteps"])
+
+
+def finalize(summary: dict):
+    log = get_logger("perf")
+    log.info("%s", _format_table(summary))
+    path = os.environ.get("LUX_METRICS")
+    if not path:
+        return
+    record = dict(summary)
+    record["metrics"] = metrics.snapshot()
+    with open(path, "a") as f:
+        f.write(json.dumps(record, separators=(",", ":")) + "\n")
+
+
+def read_last(path: str) -> dict:
+    """Read the most recent run record from a ``LUX_METRICS`` dump."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                last = line
+    if last is None:
+        raise ValueError(f"no run records in {path}")
+    return json.loads(last)

@@ -3,7 +3,10 @@
 The reference uses Legion logger categories — ``log_lux("graph")``,
 ``log_pr("pagerank")`` etc. (core/pull_model.inl:20, pagerank/pagerank.cc:26)
 — with a compile-time OUTPUT_LEVEL knob (Makefile:23). Here: stdlib logging
-with a ``LUX_LOG`` env var as the runtime knob.
+with a ``LUX_LOG`` env var as the runtime knob, re-readable at runtime via
+``reconfigure()`` (CLI flags set env vars after first import). The
+``lux.perf`` category carries the end-of-run telemetry table
+(lux_tpu/obs/report.py).
 """
 
 from __future__ import annotations
@@ -12,26 +15,46 @@ import logging
 import os
 import sys
 
+PERF_CATEGORY = "perf"
+
 _CONFIGURED = False
+_HANDLER = None
+
+
+def _apply_level(root: logging.Logger):
+    level = os.environ.get("LUX_LOG", "INFO").upper()
+    root.setLevel(getattr(logging, level, logging.INFO))
 
 
 def _configure():
-    global _CONFIGURED
+    global _CONFIGURED, _HANDLER
     if _CONFIGURED:
         return
-    level = os.environ.get("LUX_LOG", "INFO").upper()
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(
         logging.Formatter("{%(name)s} %(levelname)s: %(message)s")
     )
     root = logging.getLogger("lux")
-    root.setLevel(getattr(logging, level, logging.INFO))
+    _apply_level(root)
     root.addHandler(handler)
     root.propagate = False
     _CONFIGURED = True
+    _HANDLER = handler
+
+
+def reconfigure():
+    """Re-read ``LUX_LOG`` after the environment changed. Keeps the
+    single stderr handler; only the level moves."""
+    _configure()
+    _apply_level(logging.getLogger("lux"))
 
 
 def get_logger(category: str) -> logging.Logger:
     """e.g. get_logger('graph'), get_logger('pagerank')."""
     _configure()
     return logging.getLogger(f"lux.{category}")
+
+
+def perf_logger() -> logging.Logger:
+    """The ``lux.perf`` category used by the run-report writer."""
+    return get_logger(PERF_CATEGORY)

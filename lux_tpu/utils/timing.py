@@ -3,9 +3,11 @@
 The reference brackets its iteration loop with
 ``Realm::Clock::current_time_in_microseconds`` and prints
 ``ELAPSED TIME = %7.7f s`` (pagerank/pagerank.cc:108-118); `Timer`
-reproduces that measurement discipline (device work must be drained before
-reading the clock — the executors' ``run`` methods block before
-returning, so bracketing them is accurate).
+reproduces that measurement discipline: device work must be drained
+before reading the clock. Pass ``sync=`` (a value, pytree, or zero-arg
+callable producing one) and the timer runs ``jax.block_until_ready`` on
+it before taking the exit timestamp, so async dispatch can't make the
+bracket lie.
 """
 
 from __future__ import annotations
@@ -14,11 +16,20 @@ import time
 
 
 class Timer:
+    def __init__(self, sync=None):
+        self._sync = sync
+
     def __enter__(self):
         self.start = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self._sync is not None:
+            target = self._sync() if callable(self._sync) else self._sync
+            if target is not None:
+                import jax
+
+                jax.block_until_ready(target)
         self.elapsed = time.perf_counter() - self.start
         return False
 

@@ -53,6 +53,44 @@ def test_cli_pagerank_check(graphs):
     assert "[PASS]" in r.stdout and "ELAPSED TIME" in r.stdout
 
 
+def test_cli_telemetry_flags(graphs, tmp_path):
+    import json
+
+    mpath = str(tmp_path / "metrics.jsonl")
+    tpath = str(tmp_path / "trace.jsonl")
+    r = run_cli(
+        "lux_tpu.models.pagerank",
+        "-file", str(graphs / "g.lux"), "-ni", "4",
+        "-metrics", mpath, "-trace", tpath,
+    )
+    assert r.returncode == 0, r.stderr
+    runs = [json.loads(line) for line in open(mpath)]
+    assert runs and runs[-1]["num_iters"] == 4
+    assert len(runs[-1]["iterations"]) == 4
+    assert runs[-1]["compile_s"] > 0 and runs[-1]["execute_s"] > 0
+    events = [json.loads(line) for line in open(tpath)]
+    assert sum(e["ph"] == "B" for e in events) == \
+        sum(e["ph"] == "E" for e in events) > 0
+    # the run report table goes to the lux.perf logger on stderr
+    assert "{lux.perf}" in r.stderr and "run report:" in r.stderr
+
+
+def test_cli_telemetry_verbose_push(graphs, tmp_path):
+    import json
+
+    mpath = str(tmp_path / "metrics.jsonl")
+    r = run_cli(
+        "lux_tpu.models.components",
+        "-file", str(graphs / "u.lux"), "-verbose",
+        "--metrics", mpath,  # double-dash alias
+    )
+    assert r.returncode == 0, r.stderr
+    run = [json.loads(line) for line in open(mpath)][-1]
+    assert run["engine"] == "push" and run["num_iters"] > 0
+    # the verbose loop records per-iteration frontier sizes
+    assert all("frontier" in rec for rec in run["iterations"])
+
+
 def test_cli_pagerank_sharded(graphs):
     r = run_cli(
         "lux_tpu.models.pagerank",

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Summarize a LUX_TRACE (Chrome trace_event JSON-lines) or LUX_METRICS
+(run-telemetry JSON-lines) file: top-N spans by self time.
+
+Usage:
+  python tools/trace_summary.py TRACE.jsonl [-n 10]
+  python tools/trace_summary.py TRACE.jsonl --to-chrome out.json
+  python tools/trace_summary.py METRICS.jsonl          # run summary mode
+
+Self time = span duration minus the duration of spans nested inside it
+on the same (pid, tid) track, so a run-level span does not dwarf the
+flushes it contains. ``--to-chrome`` wraps the JSON-lines into the
+``{"traceEvents": [...]}`` envelope for drag-and-drop loading in
+Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def read_jsonl(path):
+    events = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{ln}: invalid JSON: {e}")
+    return events
+
+
+def is_metrics_dump(events) -> bool:
+    return bool(events) and str(
+        events[-1].get("schema", "")).startswith("lux.run_telemetry")
+
+
+def spans_from_events(events):
+    """Resolve B/E pairs (and X events) into (name, cat, dur_us, self_us)
+    via a per-(pid, tid) stack over time-ordered events."""
+    spans = []
+    stacks = defaultdict(list)  # (pid, tid) -> [[name, cat, t0, child_us]]
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks[key].append([ev.get("name"), ev.get("cat"),
+                                ev["ts"], 0.0])
+        elif ph == "E":
+            stack = stacks[key]
+            if not stack:
+                print(f"warning: E without B for {ev.get('name')!r}",
+                      file=sys.stderr)
+                continue
+            name, cat, t0, child_us = stack.pop()
+            dur = ev["ts"] - t0
+            if stack:
+                stack[-1][3] += dur
+            spans.append((name, cat, dur, max(dur - child_us, 0.0)))
+        elif ph == "X":
+            dur = ev.get("dur", 0.0)
+            spans.append((ev.get("name"), ev.get("cat"), dur, dur))
+    for key, stack in stacks.items():
+        for name, *_ in stack:
+            print(f"warning: unclosed span {name!r} on {key}",
+                  file=sys.stderr)
+    return spans
+
+
+def print_top_spans(spans, top_n: int):
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [count, dur, self]
+    for name, _cat, dur, self_us in spans:
+        a = agg[name]
+        a[0] += 1
+        a[1] += dur
+        a[2] += self_us
+    rows = sorted(agg.items(), key=lambda kv: kv[1][2], reverse=True)
+    print(f"{'span':<28} {'count':>6} {'total_ms':>10} {'self_ms':>10} "
+          f"{'self/call_ms':>13}")
+    for name, (count, dur, self_us) in rows[:top_n]:
+        print(f"{name:<28} {count:>6} {dur/1e3:>10.3f} {self_us/1e3:>10.3f} "
+              f"{self_us/count/1e3:>13.4f}")
+
+
+def print_metrics_summary(events, top_n: int):
+    run = events[-1]
+    print(f"run: engine={run['engine']} program={run.get('program','')} "
+          f"nv={run['nv']} ne={run['ne']}")
+    print(f"  iters={run['num_iters']} compile={run['compile_s']:.4f}s "
+          f"execute={run['execute_s']:.4f}s gteps={run['gteps']:.4f}")
+    if run.get("exchange_bytes_per_iter"):
+        print(f"  exchange: {run['exchange_bytes_per_iter']} B/iter")
+    rows = sorted(run.get("iterations", []),
+                  key=lambda r: r["t_iter_s"], reverse=True)
+    if rows:
+        print(f"  top {min(top_n, len(rows))} iterations by wall time:")
+        for r in rows[:top_n]:
+            frontier = r.get("frontier")
+            print(f"    iter {r['iter']:>5}: {r['t_iter_s']*1e3:.3f} ms"
+                  + (f"  frontier={frontier}" if frontier is not None else ""))
+    if len(events) > 1:
+        print(f"  ({len(events) - 1} earlier run(s) in the file)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="LUX_TRACE or LUX_METRICS JSON-lines file")
+    ap.add_argument("-n", "--top", type=int, default=10,
+                    help="rows to show (default 10)")
+    ap.add_argument("--to-chrome", metavar="OUT",
+                    help="write {'traceEvents': [...]} envelope to OUT for "
+                    "Perfetto / chrome://tracing")
+    args = ap.parse_args(argv)
+
+    events = read_jsonl(args.path)
+    if not events:
+        raise SystemExit(f"{args.path}: empty file")
+
+    if is_metrics_dump(events):
+        if args.to_chrome:
+            raise SystemExit("--to-chrome needs a trace file, not a "
+                             "metrics dump")
+        print_metrics_summary(events, args.top)
+        return 0
+
+    if args.to_chrome:
+        with open(args.to_chrome, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        print(f"wrote {len(events)} events to {args.to_chrome} "
+              "(load at https://ui.perfetto.dev)")
+        return 0
+
+    print_top_spans(spans_from_events(events), args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native test bench obs-smoke clean
+.PHONY: all native test bench obs-smoke serve-smoke serve-bench clean
 
 all: native
 
@@ -17,6 +17,12 @@ bench:
 
 obs-smoke:
 	python tools/obs_smoke.py
+
+serve-smoke:
+	python tools/serve_smoke.py
+
+serve-bench:
+	python tools/serve_bench.py --scale 12 --workers 16 --duration 10
 
 clean:
 	rm -rf build ~/.cache/lux_tpu_native

@@ -686,6 +686,140 @@ def _run_to_fixpoint(multi, state, max_iters, chunk, recorder=None):
     return state, total, sparse_total
 
 
+class MultiSourcePushExecutor:
+    """Dense push executor over K value columns: one O(ne) sweep serves K
+    independent root queries (multi-source micro-batching, the serving
+    layer's headline mechanism — serve/batcher.py).
+
+    State arrays are ``(nv, K)``; the pull-direction dense iteration
+    vectorizes untouched — the per-edge gather ``values[col_src]`` becomes
+    a ``(ne, K)`` row gather and the segment reduction keeps its trailing
+    lane axis, so the marginal cost of lane k+1 is one more VPU lane, not
+    another sweep. Per-lane fixpoints are monotone, so running every lane
+    until ALL are quiet (one shared halt count) only repeats no-op
+    iterations on early finishers — the same argument that justifies the
+    chunked speculative window.
+
+    Sparse/blocked strategies are single-lane-shaped (queue compaction and
+    bit-packing assume scalar values), so this executor is dense-only; the
+    serving layer routes single queries to the adaptive ``PushExecutor``
+    and batches here.
+    """
+
+    def __init__(self, graph: Graph, program: PushProgram, k: int,
+                 device=None):
+        if k < 1:
+            raise ValueError(f"batch width k must be >= 1 (got {k})")
+        if program.needs_weights and graph.weights is None:
+            raise ValueError(f"{program.name} requires an edge-weighted graph")
+        self.graph = graph
+        self.program = program
+        self.k = int(k)
+        self.device = device
+        put = lambda x: jax.device_put(jnp.asarray(x), device)
+        dg = {
+            "col_src": put(graph.col_src.astype(np.int32)),
+            "seg_ids": put(graph.col_dst),
+        }
+        if graph.weights is not None:
+            dg["weights"] = put(graph.weights)
+        self._dg = dg
+        self.sparse_iters = 0   # API parity with PushExecutor (always 0)
+        self._multi_jit = jax.jit(
+            self._chunk_impl, donate_argnums=0, static_argnums=2
+        )
+
+    def init_state(self, starts) -> PushState:
+        """State with one value/frontier column per root in ``starts``.
+        Fewer than k roots are right-padded by repeating the last root —
+        duplicate lanes converge identically, so padding never changes
+        results or iteration counts, and the executable stays one shape."""
+        starts = list(starts)
+        if not 1 <= len(starts) <= self.k:
+            raise ValueError(
+                f"need 1..{self.k} roots, got {len(starts)}"
+            )
+        starts = starts + [starts[-1]] * (self.k - len(starts))
+        prog = self.program
+        vals = np.stack(
+            [prog.init_values(self.graph, start=s) for s in starts], axis=1
+        )
+        fr = np.stack(
+            [prog.init_frontier(self.graph, start=s) for s in starts], axis=1
+        )
+        return PushState(
+            jax.device_put(jnp.asarray(vals), self.device),
+            jax.device_put(jnp.asarray(fr), self.device),
+        )
+
+    def _one_iter(self, state: PushState, dg):
+        prog = self.program
+        src_vals = state.values[dg["col_src"]]        # (ne, K)
+        src_front = state.frontier[dg["col_src"]]
+        w = dg.get("weights")
+        cand = prog.relax(src_vals, None if w is None else w[:, None])
+        ident = identity_for(prog.combiner, cand.dtype)
+        cand = jnp.where(src_front, cand, ident)
+        acc = segment_reduce(
+            cand, dg["seg_ids"], num_segments=self.graph.nv,
+            kind=prog.combiner,
+        )
+        if prog.combiner == "min":
+            new = jnp.minimum(state.values, acc)
+        else:
+            new = jnp.maximum(state.values, acc)
+        frontier = new != state.values
+        return (
+            PushState(new, frontier),
+            frontier.sum(dtype=jnp.int32),
+            jnp.int32(0),
+        )
+
+    def _chunk_impl(self, state: PushState, dg, k: int, limit=None):
+        return _chunk_while(
+            lambda st: self._one_iter(st, dg), state, k, limit
+        )
+
+    def _multi(self, state: PushState, limit: int, k: int):
+        return self._multi_jit(state, self._dg, k, limit=jnp.int32(limit))
+
+    def run(
+        self,
+        starts,
+        max_iters: Optional[int] = None,
+        chunk: int = 16,
+        recorder=None,
+    ):
+        """Run all roots in ``starts`` to their shared fixpoint; returns
+        (final_state, iterations_run). Column j of ``state.values`` is
+        root ``starts[j]``'s result — bit-identical to a single-source
+        ``PushExecutor`` run from that root (tests/test_serve.py)."""
+        state = self.init_state(starts)
+        rec = recorder if recorder is not None else recorder_for(
+            "push_multi", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+        state, total, _ = _run_to_fixpoint(
+            self._multi, state, max_iters, chunk, recorder=rec
+        )
+        rec.finish()
+        return state, total
+
+    def warmup(self, chunk: int = 16, start: int = 0):
+        """Compile the chunked executable outside any timed/served
+        request (the serving pool calls this once per keyed engine)."""
+        with Timer() as t:
+            _run_to_fixpoint(
+                self._multi, self.init_state([start]), 1, chunk
+            )
+        note_compile_seconds(self, t.elapsed)
+
+    def values_for(self, state: PushState, j: int) -> np.ndarray:
+        """Host copy of lane ``j``'s value column."""
+        return np.asarray(jax.device_get(state.values[:, j]))
+
+
 class ShardedPushExecutor:
     """Push executor over an N-device mesh with the same two per-iteration
     strategies as the single-device engine, chosen on-device each

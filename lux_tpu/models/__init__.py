@@ -6,9 +6,37 @@ from lux_tpu.models.sssp import SSSP
 from lux_tpu.models.components import ConnectedComponents
 from lux_tpu.models.colfilter import CollaborativeFiltering
 
+# App registry: the one name → program mapping shared by the serving
+# layer (serve/session.py routes queries by these names) and tools.
+# Programs with ``rooted=True`` take a per-query root (``start``) and are
+# eligible for multi-source micro-batching; root-free fixpoints are
+# served from the result cache instead.
+PROGRAMS = {
+    "pagerank": PageRank,
+    "sssp": SSSP,
+    "components": ConnectedComponents,
+    "colfilter": CollaborativeFiltering,
+}
+
+ROOTED_APPS = frozenset({"sssp"})
+
+
+def get_program(name: str):
+    """Instantiate the vertex program registered under ``name``."""
+    try:
+        return PROGRAMS[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown app {name!r}; registered: {sorted(PROGRAMS)}"
+        ) from None
+
+
 __all__ = [
     "PageRank",
     "SSSP",
     "ConnectedComponents",
     "CollaborativeFiltering",
+    "PROGRAMS",
+    "ROOTED_APPS",
+    "get_program",
 ]

@@ -111,6 +111,27 @@ class Histogram:
                 self.bucket_counts[i] += 1
                 break
 
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile (0..1) from the bucket counts, linearly
+        interpolated within the winning bucket (the standard
+        histogram_quantile estimate). Serving latency SLOs (p50/p99 in
+        /stats and tools/serve_bench.py) read this; exact quantiles would
+        need the raw observations we deliberately don't keep."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        lo = 0.0
+        for b, c in zip(self.bounds, self.bucket_counts):
+            if seen + c >= rank and c > 0:
+                if b == float("inf"):
+                    return lo  # open-ended bucket: report its lower bound
+                frac = (rank - seen) / c
+                return lo + (b - lo) * frac
+            seen += c
+            lo = b if b != float("inf") else lo
+        return lo
+
     def snapshot(self) -> dict:
         return {
             "name": self.name, "kind": self.kind, "labels": self.labels,

@@ -1,0 +1,36 @@
+"""Warm-engine query serving with multi-source micro-batching.
+
+The offline CLI pays graph load + XLA compile per invocation; this
+subsystem pays them once. A :class:`Session` loads the graph, keeps
+compiled executors in a keyed :class:`EnginePool`, answers queries
+through a bounded admission queue (:class:`MicroBatcher`), and fronts an
+LRU :class:`ResultCache`. K concurrent SSSP root queries inside one
+batching window run as ONE dense multi-source sweep
+(engine/push.py ``MultiSourcePushExecutor``); root-free fixpoints
+(PageRank, CC) are served from the cache. ``serve/http.py`` is the
+stdlib JSON front end: ``python -m lux_tpu.serve.http -file g.lux``.
+"""
+
+from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.cache import ResultCache
+from lux_tpu.serve.errors import (
+    BadQueryError,
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+)
+from lux_tpu.serve.pool import EnginePool
+from lux_tpu.serve.session import ServeConfig, Session
+
+__all__ = [
+    "Session",
+    "ServeConfig",
+    "EnginePool",
+    "ResultCache",
+    "MicroBatcher",
+    "Request",
+    "ServeError",
+    "QueueFullError",
+    "DeadlineExceededError",
+    "BadQueryError",
+]

@@ -1,0 +1,188 @@
+"""Admission queue + multi-source micro-batcher.
+
+One worker thread drains a bounded queue. On each wakeup it takes the
+oldest request, then keeps collecting until either the batching window
+closes or the batch is full — the classic inference-serving tradeoff
+(window of latency traded for batched throughput), applied to graph
+traversal: K root queries that share a (program, graph) key become ONE
+dense multi-source sweep (engine/push.py MultiSourcePushExecutor).
+
+Admission control:
+- ``submit`` never blocks: a full queue raises ``QueueFullError``
+  immediately (backpressure to the client, HTTP 429) instead of
+  deadlocking producers behind a slow engine.
+- every request may carry a deadline; requests whose deadline passed
+  while queued are shed at dequeue with ``DeadlineExceededError`` and an
+  `obs` counter increment — they never occupy engine time.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Hashable, List, Optional
+
+from lux_tpu.obs import metrics, trace
+from lux_tpu.serve.errors import DeadlineExceededError, QueueFullError
+
+# Batch sizes are small integers; the seconds-oriented default bucket
+# bounds would collapse them into two buckets.
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, float("inf"))
+
+
+@dataclass
+class Request:
+    """One admitted query. ``batch_key`` groups batchable requests (same
+    program + graph + engine config); ``batch_key=None`` means the
+    request must execute alone. ``payload`` is interpreted by the
+    executor callback (for SSSP batches: the root vertex)."""
+
+    app: str
+    payload: Any
+    batch_key: Optional[Hashable]
+    future: Future = field(default_factory=Future)
+    deadline: Optional[float] = None      # time.monotonic() stamp
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.monotonic()) > self.deadline
+
+
+class MicroBatcher:
+    """Bounded admission queue + window-based batch former.
+
+    ``execute(requests)`` is called on the worker thread with a list of
+    requests sharing one ``batch_key`` (or a singleton list for
+    unbatchable requests); it must resolve every request's future.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[List[Request]], None],
+        max_batch: int = 8,
+        window_s: float = 0.003,
+        max_queue: int = 64,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1 (got {max_batch})")
+        self._execute = execute
+        self.max_batch = int(max_batch)
+        self.window_s = float(window_s)
+        self._q: "queue.Queue[Request]" = queue.Queue(maxsize=max_queue)
+        self._rejected = metrics.counter("lux_serve_rejected_total")
+        self._expired = metrics.counter("lux_serve_deadline_expired_total")
+        self._depth = metrics.gauge("lux_serve_queue_depth")
+        self._batch_hist = metrics.histogram(
+            "lux_serve_batch_size", buckets=BATCH_SIZE_BUCKETS
+        )
+        self._closed = False
+        self._carry: Optional[Request] = None   # worker-thread-only state
+        self._thread = threading.Thread(
+            target=self._loop, name="lux-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, req: Request) -> Future:
+        """Admit ``req`` or raise ``QueueFullError`` without blocking."""
+        if self._closed:
+            raise QueueFullError("server is shutting down")
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._rejected.inc()
+            raise QueueFullError(
+                f"admission queue full ({self._q.maxsize} pending); retry"
+            ) from None
+        self._depth.set(self._q.qsize())
+        return req.future
+
+    # -- worker side -----------------------------------------------------
+
+    def _collect(self, first: Request) -> List[Request]:
+        """``first`` plus whatever arrives before the window closes, up
+        to max_batch. Only requests matching ``first.batch_key`` extend
+        the batch; a non-matching arrival ends collection and leads the
+        next batch (FIFO across batches, no starvation)."""
+        batch = [first]
+        if first.batch_key is None or self.max_batch == 1:
+            return batch
+        t_close = time.monotonic() + self.window_s
+        while len(batch) < self.max_batch:
+            remaining = t_close - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt.batch_key == first.batch_key:
+                batch.append(nxt)
+            else:
+                self._carry = nxt
+                break
+        return batch
+
+    def _loop(self):
+        while True:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._closed:
+                        return
+                    continue
+            batch = self._collect(first)
+            self._depth.set(self._q.qsize())
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.expired(now):
+                    self._expired.inc()
+                    r.future.set_exception(DeadlineExceededError(
+                        f"deadline expired after "
+                        f"{now - r.enqueued_at:.3f}s in queue"
+                    ))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            self._batch_hist.observe(len(live))
+            with trace.span("serve.batch", cat="serve",
+                            app=live[0].app, size=len(live)):
+                try:
+                    self._execute(live)
+                except Exception as e:  # engine bug: fail the batch, keep serving
+                    for r in live:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+
+    def close(self, timeout: float = 5.0):
+        """Stop admitting, drain the worker, fail leftover requests."""
+        self._closed = True
+        self._thread.join(timeout)
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.future.set_exception(QueueFullError("server shut down"))
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self._q.qsize(),
+            "queue_capacity": self._q.maxsize,
+            "rejected": int(self._rejected.value),
+            "deadline_expired": int(self._expired.value),
+            "batches": int(self._batch_hist.count),
+            "max_batch": self.max_batch,
+            "window_s": self.window_s,
+        }

@@ -1,0 +1,61 @@
+"""LRU result cache keyed by (graph fingerprint, query key).
+
+PageRank/CC answers are root-independent (one converged array serves
+every client) and SSSP repeats are common in online traversal traffic
+(PAPERS.md: Gunrock's query mix), so a small LRU in front of the engines
+turns repeat queries into dictionary hits. Keys must embed the graph
+fingerprint — the hardened utils/checkpoint.fingerprint — so a server
+rotated onto a new graph can never serve stale arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+from lux_tpu.obs import metrics
+
+
+class ResultCache:
+    """Thread-safe LRU over query results (host numpy arrays)."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1 (got {capacity})")
+        self.capacity = capacity
+        self._d: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = metrics.counter("lux_serve_cache_hits_total")
+        self._misses = metrics.counter("lux_serve_cache_misses_total")
+        self._evictions = metrics.counter("lux_serve_cache_evictions_total")
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self._hits.inc()
+                return self._d[key]
+            self._misses.inc()
+            return None
+
+    def put(self, key: Hashable, value: Any) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+                self._evictions.inc()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def stats(self) -> dict:
+        return {
+            "size": len(self),
+            "capacity": self.capacity,
+            "hits": int(self._hits.value),
+            "misses": int(self._misses.value),
+            "evictions": int(self._evictions.value),
+        }

@@ -1,0 +1,37 @@
+"""Serving-layer error taxonomy.
+
+Each class maps to one HTTP status in serve/http.py and one `obs`
+counter, so clients and dashboards see the same three failure modes:
+overload (backpressure), timeout (deadline shed), and bad input.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base class for serving failures."""
+
+    http_status = 500
+
+
+class QueueFullError(ServeError):
+    """The bounded admission queue is full — backpressure, not deadlock.
+
+    The client should retry with backoff; the server sheds instantly
+    instead of queueing unboundedly (HTTP 429)."""
+
+    http_status = 429
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired before execution started (or the
+    batch it rode in missed it); HTTP 504."""
+
+    http_status = 504
+
+
+class BadQueryError(ServeError):
+    """Malformed query: unknown app, missing/out-of-range parameters
+    (HTTP 400)."""
+
+    http_status = 400

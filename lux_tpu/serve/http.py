@@ -1,0 +1,218 @@
+"""Stdlib JSON/HTTP front end over a serving Session.
+
+Endpoints:
+
+- ``POST /query`` — body ``{"app": "sssp", "start": 3}``; optional
+  ``"deadline_s"`` (per-request deadline), ``"targets": [v, ...]``
+  (return only those vertices' values) or ``"full": true`` (the whole
+  value array — gated by a size cap so a misdirected client cannot pull
+  multi-GB arrays through JSON). Default response carries summary stats
+  only.
+- ``GET /healthz`` — liveness + graph identity (nv, ne, fingerprint).
+- ``GET /stats`` — pool/cache/batcher counters + latency quantiles.
+- ``GET /metrics`` — full `obs` metrics-registry snapshot (JSON).
+
+Error mapping: ``BadQueryError`` → 400, ``QueueFullError`` → 429,
+``DeadlineExceededError`` → 504 (serve/errors.py owns the taxonomy).
+
+``ThreadingHTTPServer`` gives one thread per in-flight request, which is
+exactly what the micro-batcher wants: concurrent requests are all parked
+inside the batching window and come out as one multi-source sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from lux_tpu.obs import metrics
+from lux_tpu.serve.errors import ServeError, BadQueryError
+from lux_tpu.serve.session import ServeConfig, Session
+from lux_tpu.utils.logging import get_logger
+
+# Above this many vertices, "full": true is refused; use "targets".
+FULL_VALUES_CAP = 1 << 20
+
+
+def _jsonable(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def render_result(result: dict, body: dict, nv: int) -> dict:
+    """Shape one engine result for the wire: targets / full / summary."""
+    vals = result["values"]
+    out = {k: _jsonable(v) for k, v in result.items() if k != "values"}
+    targets = body.get("targets")
+    if targets is not None:
+        targets = [int(t) for t in targets]
+        bad = [t for t in targets if not 0 <= t < nv]
+        if bad:
+            raise BadQueryError(f"targets out of range [0, {nv}): {bad}")
+        out["targets"] = targets
+        out["values"] = [_jsonable(vals[t]) for t in targets]
+    elif body.get("full"):
+        if nv > FULL_VALUES_CAP:
+            raise BadQueryError(
+                f"full values refused for nv={nv} > {FULL_VALUES_CAP}; "
+                "use 'targets'"
+            )
+        out["values"] = vals.tolist()
+    else:
+        out["summary"] = {
+            "min": _jsonable(vals.min()),
+            "max": _jsonable(vals.max()),
+            "mean": float(np.asarray(vals, dtype=np.float64).mean()),
+        }
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by make_server():
+    session: Session = None
+    log = None
+
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, status: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):   # route through lux logging
+        if self.log is not None:
+            self.log.debug("%s " + fmt, self.address_string(), *args)
+
+    def do_GET(self):
+        s = self.session
+        if self.path == "/healthz":
+            self._reply(200, {
+                "ok": True, "nv": s.graph.nv, "ne": s.graph.ne,
+                "fingerprint": s.fingerprint,
+            })
+        elif self.path == "/stats":
+            self._reply(200, s.stats())
+        elif self.path == "/metrics":
+            self._reply(200, {"metrics": metrics.snapshot()})
+        else:
+            self._reply(404, {"error": f"no such endpoint {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/query":
+            self._reply(404, {"error": f"no such endpoint {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise BadQueryError("body must be a JSON object")
+            app = body.get("app")
+            params = {
+                k: v for k, v in body.items()
+                if k in ("start", "ni")
+            }
+            result = self.session.query(
+                app, deadline_s=body.get("deadline_s"), **params
+            )
+            self._reply(
+                200, render_result(result, body, self.session.graph.nv)
+            )
+        except ServeError as e:
+            self._reply(e.http_status, {
+                "error": str(e), "kind": type(e).__name__,
+            })
+        except json.JSONDecodeError as e:
+            self._reply(400, {"error": f"bad JSON: {e}", "kind": "BadQueryError"})
+        except Exception as e:   # engine bug: surface, keep serving
+            self._reply(500, {"error": str(e), "kind": type(e).__name__})
+
+    # query() futures raise ServeError subclasses; unwrap happens via
+    # Future.result() re-raising them directly, so do_POST's except
+    # clauses see the original types.
+
+
+def make_server(
+    session: Session, host: str = "127.0.0.1", port: int = 8399
+) -> ThreadingHTTPServer:
+    """An HTTP server bound to ``host:port`` serving ``session``; the
+    caller owns ``serve_forever`` (run it in a thread for embedding)."""
+    handler = type("LuxServeHandler", (_Handler,), {
+        "session": session, "log": get_logger("serve.http"),
+    })
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_in_thread(session: Session, host="127.0.0.1", port=0):
+    """Start a server on a background thread; returns (server, thread).
+    ``port=0`` binds an ephemeral port — read ``server.server_address``."""
+    server = make_server(session, host, port)
+    t = threading.Thread(
+        target=server.serve_forever, name="lux-serve-http", daemon=True
+    )
+    t.start()
+    return server, t
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lux_tpu.serve", description="warm-engine graph query server"
+    )
+    p.add_argument("-file", required=True, help="input .lux graph")
+    p.add_argument("-host", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8399)
+    p.add_argument("-max-batch", type=int, default=8, dest="max_batch",
+                   help="multi-source lanes per SSSP sweep")
+    p.add_argument("-window-ms", type=float, default=3.0, dest="window_ms",
+                   help="micro-batching window")
+    p.add_argument("-max-queue", type=int, default=64, dest="max_queue",
+                   help="admission queue bound (backpressure beyond)")
+    p.add_argument("-deadline-s", type=float, default=None,
+                   dest="deadline_s", help="default per-request deadline")
+    p.add_argument("-pagerank-iters", type=int, default=20,
+                   dest="pagerank_iters")
+    args = p.parse_args(argv)
+
+    log = get_logger("serve")
+    cfg = ServeConfig(
+        max_batch=args.max_batch,
+        window_s=args.window_ms / 1e3,
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_s,
+        pagerank_iters=args.pagerank_iters,
+    )
+    session = Session(args.file, cfg)
+    server = make_server(session, args.host, args.port)
+    log.info(
+        "serving %s (nv=%d ne=%d) on http://%s:%d  "
+        "[max_batch=%d window=%.1fms queue=%d]",
+        args.file, session.graph.nv, session.graph.ne,
+        args.host, server.server_address[1],
+        cfg.max_batch, cfg.window_s * 1e3, cfg.max_queue,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        session.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,59 @@
+"""Warm-engine pool: compiled executors keyed so repeats never recompile.
+
+The CLI path (models/cli.py) builds a fresh executor — graph transfer +
+XLA compile — per invocation; a served query must not. The pool keys an
+executor by everything that changes its executable: (program name, graph
+fingerprint, engine kind, parts, strategy/batch-width), builds it at most
+once, warms it (compile outside any request), and hands the same object
+to every subsequent query. Hit/miss counters are the smoke test's
+"zero recompiles after warmup" evidence.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Hashable
+
+from lux_tpu.obs import metrics, trace
+
+
+class EnginePool:
+    """Thread-safe keyed singleton store for warmed executors."""
+
+    def __init__(self):
+        self._engines = {}
+        self._lock = threading.Lock()
+        self._hits = metrics.counter("lux_serve_pool_hits_total")
+        self._misses = metrics.counter("lux_serve_pool_misses_total")
+
+    def get(self, key: Hashable, factory: Callable[[], object]):
+        """The executor for ``key``, building (and warming, if the
+        executor has a ``warmup``) via ``factory`` on first request.
+
+        The build runs under the lock: concurrent first requests for one
+        key must not compile twice, and the serving layer funnels engine
+        work through one batcher thread anyway."""
+        with self._lock:
+            ex = self._engines.get(key)
+            if ex is not None:
+                self._hits.inc()
+                return ex
+            self._misses.inc()
+            with trace.span("serve.engine_build", cat="serve",
+                            key=str(key)):
+                ex = factory()
+                if hasattr(ex, "warmup"):
+                    ex.warmup()
+            self._engines[key] = ex
+            return ex
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._engines)
+
+    def stats(self) -> dict:
+        return {
+            "engines": len(self),
+            "hits": int(self._hits.value),
+            "misses": int(self._misses.value),
+        }

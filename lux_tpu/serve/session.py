@@ -1,0 +1,344 @@
+"""Programmatic serving session: graph loaded once, engines warm, queries
+answered through the micro-batcher.
+
+Query routing:
+
+- ``sssp`` (root queries, the dominant online traversal workload) —
+  batchable: K concurrent roots inside one batching window run as ONE
+  dense multi-source sweep over ``(nv, K)`` values; a batch of one runs
+  on the adaptive single-source ``PushExecutor`` (its sparse tiers beat a
+  1-lane dense sweep). Both executors live in the warm pool, so neither
+  path recompiles after warmup.
+- ``pagerank`` — served from the LRU cache of converged results (one
+  fixpoint array answers every client at a given iteration count); cache
+  misses run the pull executor once.
+- ``components`` — root-free like PageRank: one converged labeling is
+  cached and sliced per query.
+
+Every result cache key embeds the hardened graph fingerprint
+(utils/checkpoint.fingerprint), so answers can never leak across graphs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import metrics, trace
+from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.cache import ResultCache
+from lux_tpu.serve.errors import BadQueryError
+from lux_tpu.serve.pool import EnginePool
+from lux_tpu.utils import checkpoint
+from lux_tpu.utils.logging import get_logger
+
+
+class ServeConfig:
+    """Serving knobs (one object so the HTTP CLI, tools, and tests agree
+    on defaults)."""
+
+    def __init__(
+        self,
+        max_batch: int = 8,          # K: multi-source lanes per sweep
+        window_s: float = 0.003,     # batching window
+        max_queue: int = 64,         # admission queue bound
+        cache_capacity: int = 256,   # LRU entries
+        default_deadline_s: Optional[float] = None,
+        pagerank_iters: int = 20,    # served fixpoint depth
+    ):
+        self.max_batch = int(max_batch)
+        self.window_s = float(window_s)
+        self.max_queue = int(max_queue)
+        self.cache_capacity = int(cache_capacity)
+        self.default_deadline_s = default_deadline_s
+        self.pagerank_iters = int(pagerank_iters)
+
+
+class Session:
+    """One served graph: load once, keep engines warm, answer queries.
+
+    Thread-safe: ``submit``/``query`` may be called from any number of
+    request threads; engine work funnels through the batcher thread.
+    """
+
+    APPS = ("sssp", "components", "pagerank")
+
+    def __init__(
+        self,
+        graph: Union[Graph, str],
+        config: Optional[ServeConfig] = None,
+        warm: bool = True,
+    ):
+        self.log = get_logger("serve")
+        self.config = config or ServeConfig()
+        self.graph_path: Optional[str] = None
+        if isinstance(graph, str):
+            from lux_tpu.native import io as native_io
+
+            self.graph_path = graph
+            graph = native_io.read_lux(graph)
+        self.graph = graph
+        self.fingerprint = checkpoint.fingerprint_hex(graph)
+        self.pool = EnginePool()
+        self.cache = ResultCache(self.config.cache_capacity)
+        self.batcher = MicroBatcher(
+            self._execute_batch,
+            max_batch=self.config.max_batch,
+            window_s=self.config.window_s,
+            max_queue=self.config.max_queue,
+        )
+        self._requests = metrics.counter("lux_serve_requests_total")
+        self._latency = metrics.histogram("lux_serve_request_seconds")
+        self._closed = False
+        if warm:
+            self.warmup()
+
+    # -- engines ---------------------------------------------------------
+
+    def _engine_key(self, kind: str, extra=()) -> tuple:
+        return (kind, self.fingerprint) + tuple(extra)
+
+    def _sssp_single(self):
+        from lux_tpu.engine.push import PushExecutor
+        from lux_tpu.models.sssp import SSSP
+
+        return self.pool.get(
+            self._engine_key("push", ("sssp", 1)),
+            lambda: PushExecutor(self.graph, SSSP()),
+        )
+
+    def _sssp_multi(self):
+        from lux_tpu.engine.push import MultiSourcePushExecutor
+        from lux_tpu.models.sssp import SSSP
+
+        k = self.config.max_batch
+        return self.pool.get(
+            self._engine_key("push_multi", ("sssp", k)),
+            lambda: MultiSourcePushExecutor(self.graph, SSSP(), k=k),
+        )
+
+    def _components_engine(self):
+        from lux_tpu.engine.push import PushExecutor
+        from lux_tpu.models.components import ConnectedComponents
+
+        return self.pool.get(
+            self._engine_key("push", ("components", 1)),
+            lambda: PushExecutor(self.graph, ConnectedComponents()),
+        )
+
+    def _pagerank_engine(self):
+        from lux_tpu.models.cli import make_executor
+        from lux_tpu.models.pagerank import PageRank
+
+        def build():
+            from lux_tpu.engine.pull import PullExecutor
+
+            if self.graph_path is None:
+                # The tiled fast path persists its hybrid plan next to
+                # the graph file; an in-memory graph has none, so serve
+                # from the flat pull engine.
+                return PullExecutor(self.graph, PageRank())
+            import argparse
+
+            # Reuse the CLI's engine-selection policy (tiled when
+            # SpMV-shaped) with serving defaults.
+            args = argparse.Namespace(
+                parts=1, layout="auto", strategy="rowptr",
+                levels="8/2", tile_mb=8192, plan_cache=None,
+                file=self.graph_path,
+            )
+            return make_executor(self.graph, PageRank(), args, self.log)
+
+        return self.pool.get(
+            self._engine_key("pull", ("pagerank",)), build
+        )
+
+    def warmup(self):
+        """Build + compile every served engine before traffic arrives.
+        After this, the pool miss counter is the recompile count: the
+        smoke test asserts it stays flat across the query phase."""
+        with trace.span("serve.warmup", cat="serve"):
+            with _timed(self.log, "warmup sssp single"):
+                self._sssp_single()
+            with _timed(self.log, "warmup sssp multi"):
+                self._sssp_multi()
+            with _timed(self.log, "warmup components"):
+                self._components_engine()
+            with _timed(self.log, "warmup pagerank"):
+                self._pagerank_engine()
+
+    # -- query front door ------------------------------------------------
+
+    def submit(
+        self,
+        app: str,
+        deadline_s: Optional[float] = None,
+        **params,
+    ) -> Future:
+        """Admit one query; returns a Future resolving to a dict with at
+        least ``values`` (np.ndarray) and ``iters``. Raises
+        ``BadQueryError`` on malformed queries and ``QueueFullError``
+        under overload; the Future raises ``DeadlineExceededError`` when
+        shed."""
+        if self._closed:
+            raise BadQueryError("session is closed")
+        app = str(app)
+        if app not in self.APPS:
+            raise BadQueryError(
+                f"unknown app {app!r}; serving {list(self.APPS)}"
+            )
+        self._requests.inc()
+        metrics.counter(
+            "lux_serve_requests_total", {"app": app}
+        ).inc()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        deadline = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        t0 = time.perf_counter()
+
+        if app == "sssp":
+            fut = self._submit_sssp(params, deadline)
+        elif app == "components":
+            fut = self._submit_cached_fixpoint(
+                app, ("components",), self._run_components, deadline
+            )
+        else:
+            ni = int(params.get("ni", self.config.pagerank_iters))
+            if ni < 1:
+                raise BadQueryError(f"pagerank ni must be >= 1 (got {ni})")
+            fut = self._submit_cached_fixpoint(
+                app, ("pagerank", ni),
+                lambda: self._run_pagerank(ni), deadline,
+            )
+        fut.add_done_callback(
+            lambda f: self._latency.observe(time.perf_counter() - t0)
+        )
+        return fut
+
+    def query(self, app: str, timeout: Optional[float] = None, **params):
+        """Synchronous ``submit``; blocks for the result."""
+        return self.submit(app, **params).result(timeout=timeout)
+
+    def _submit_sssp(self, params: dict, deadline) -> Future:
+        try:
+            start = int(params["start"])
+        except (KeyError, TypeError, ValueError):
+            raise BadQueryError("sssp needs an integer 'start' root")
+        if not 0 <= start < self.graph.nv:
+            raise BadQueryError(
+                f"sssp start {start} out of range [0, {self.graph.nv})"
+            )
+        key = (self.fingerprint, "sssp", start)
+        hit = self.cache.get(key)
+        if hit is not None:
+            fut: Future = Future()
+            fut.set_result(hit)
+            return fut
+        req = Request(
+            app="sssp", payload=start,
+            batch_key=("sssp", self.fingerprint, self.config.max_batch),
+            deadline=deadline,
+        )
+        return self.batcher.submit(req)
+
+    def _submit_cached_fixpoint(self, app, key_tail, run, deadline) -> Future:
+        key = (self.fingerprint,) + tuple(key_tail)
+        hit = self.cache.get(key)
+        if hit is not None:
+            fut: Future = Future()
+            fut.set_result(hit)
+            return fut
+        req = Request(app=app, payload=(key, run), batch_key=None,
+                      deadline=deadline)
+        return self.batcher.submit(req)
+
+    # -- batcher executor callback ---------------------------------------
+
+    def _execute_batch(self, batch: List[Request]):
+        if batch[0].app == "sssp":
+            self._execute_sssp_batch(batch)
+            return
+        # Unbatchable request (singleton list): cached fixpoint runner.
+        (key, run) = batch[0].payload
+        hit = self.cache.get(key)   # raced submits may have filled it
+        if hit is None:
+            hit = run()
+            self.cache.put(key, hit)
+        batch[0].future.set_result(hit)
+
+    def _execute_sssp_batch(self, batch: List[Request]):
+        roots = [r.payload for r in batch]
+        if len(batch) == 1:
+            ex = self._sssp_single()
+            state, iters = ex.run(start=roots[0])
+            results = [np.asarray(state.values)]
+        else:
+            ex = self._sssp_multi()
+            state, iters = ex.run(roots)
+            results = [ex.values_for(state, j) for j in range(len(roots))]
+        for r, root, vals in zip(batch, roots, results):
+            out = {"values": vals, "iters": int(iters), "start": root}
+            self.cache.put((self.fingerprint, "sssp", root), out)
+            r.future.set_result(out)
+
+    def _run_components(self) -> dict:
+        ex = self._components_engine()
+        state, iters = ex.run()
+        return {"values": np.asarray(state.values), "iters": int(iters)}
+
+    def _run_pagerank(self, ni: int) -> dict:
+        from lux_tpu.models.cli import final_values
+
+        ex = self._pagerank_engine()
+        vals = ex.run(ni)
+        return {"values": final_values(ex, vals), "iters": ni}
+
+    # -- introspection / lifecycle ---------------------------------------
+
+    def stats(self) -> dict:
+        s = {
+            "graph": {"nv": self.graph.nv, "ne": self.graph.ne,
+                      "fingerprint": self.fingerprint},
+            "pool": self.pool.stats(),
+            "cache": self.cache.stats(),
+            "batcher": self.batcher.stats(),
+            "requests": int(self._requests.value),
+        }
+        if self._latency.count:
+            s["latency_s"] = {
+                "count": self._latency.count,
+                "p50": self._latency.quantile(0.5),
+                "p99": self._latency.quantile(0.99),
+            }
+        return s
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _timed:
+    def __init__(self, log, what):
+        self.log, self.what = log, what
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+
+    def __exit__(self, *exc):
+        self.log.info(
+            "%s: %.2fs", self.what, time.perf_counter() - self.t0
+        )

@@ -4,11 +4,15 @@ The reference has none (SURVEY.md §5: state lives in device regions and is
 never written back). Here vertex values are plain arrays, so checkpointing
 is one compressed npz per snapshot: values + iteration counter + graph
 fingerprint (to refuse resuming onto a different graph).
+
+The fingerprint doubles as the serving layer's cache key (serve/session.py):
+two graphs must not collide just because their edge *sources* agree, so it
+samples all three structural arrays (sources, destinations, offsets).
 """
 
 from __future__ import annotations
 
-import dataclasses
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -16,13 +20,45 @@ import numpy as np
 from lux_tpu.graph.graph import Graph
 
 
-def _fingerprint(graph: Graph) -> np.ndarray:
-    # Cheap structural hash: counts plus a sample of the edge array.
-    sample = graph.col_src[:: max(1, graph.ne // 1024)][:1024]
+class CheckpointError(ValueError):
+    """A checkpoint file is missing, unreadable, or structurally wrong."""
+
+
+def _sample_sum(a: np.ndarray, want: int = 1024) -> int:
+    """Order-sensitive digest of up to ``want`` evenly-strided elements:
+    each sample is weighted by its rank so permutations of the same
+    multiset hash differently."""
+    s = a[:: max(1, len(a) // want)][:want].astype(np.int64)
+    return int(((np.arange(len(s), dtype=np.int64) + 1) * s).sum())
+
+
+def fingerprint(graph: Graph) -> np.ndarray:
+    """Cheap structural hash: counts plus rank-weighted samples of the
+    edge sources, edge destinations, and CSC offsets. Sampling col_src
+    alone (the pre-serving form) collided for graphs with identical
+    sources but different destinations — e.g. the same out-edge multiset
+    wired to different targets."""
     return np.array(
-        [graph.nv, graph.ne, int(sample.astype(np.int64).sum())],
+        [
+            graph.nv,
+            graph.ne,
+            _sample_sum(graph.col_src),
+            _sample_sum(graph.col_dst),
+            _sample_sum(graph.row_ptr),
+        ],
         dtype=np.int64,
     )
+
+
+def fingerprint_hex(graph: Graph) -> str:
+    """Compact string form of :func:`fingerprint` for dict/cache keys and
+    JSON payloads (serving cache, /healthz)."""
+    return "-".join(format(int(v) & 0xFFFFFFFFFFFFFFFF, "x")
+                    for v in fingerprint(graph))
+
+
+# Backwards-compatible alias (pre-serving internal name).
+_fingerprint = fingerprint
 
 
 def save(path: str, graph: Graph, values: np.ndarray, iteration: int,
@@ -30,7 +66,7 @@ def save(path: str, graph: Graph, values: np.ndarray, iteration: int,
     payload = {
         "values": values,
         "iteration": np.int64(iteration),
-        "fingerprint": _fingerprint(graph),
+        "fingerprint": fingerprint(graph),
     }
     if frontier is not None:
         payload["frontier"] = frontier
@@ -43,10 +79,37 @@ def save(path: str, graph: Graph, values: np.ndarray, iteration: int,
 def load(
     path: str, graph: Graph
 ) -> Tuple[np.ndarray, int, Optional[np.ndarray]]:
-    with np.load(path) as z:
-        if not np.array_equal(z["fingerprint"], _fingerprint(graph)):
-            raise ValueError(
+    """Load a checkpoint for ``graph``.
+
+    Raises :class:`CheckpointError` (a ``ValueError``) with a clear
+    message on a missing file, a non-npz/corrupt file, or an npz missing
+    the checkpoint fields — the serving layer hits all three under churn
+    and must surface them as client errors, not raw ``KeyError``s."""
+    if not os.path.exists(path):
+        raise CheckpointError(f"{path}: checkpoint file does not exist")
+    try:
+        z = np.load(path)
+    except Exception as e:
+        raise CheckpointError(
+            f"{path}: not a readable checkpoint npz ({e})"
+        ) from e
+    with z:
+        missing = {"values", "iteration", "fingerprint"} - set(z.files)
+        if missing:
+            raise CheckpointError(
+                f"{path}: checkpoint is missing field(s) "
+                f"{sorted(missing)} (corrupt or not a lux checkpoint)"
+            )
+        if not np.array_equal(z["fingerprint"], fingerprint(graph)):
+            raise CheckpointError(
                 f"{path}: checkpoint belongs to a different graph"
             )
-        frontier = z["frontier"] if "frontier" in z.files else None
-        return z["values"], int(z["iteration"]), frontier
+        try:
+            values = z["values"]
+            iteration = int(z["iteration"])
+            frontier = z["frontier"] if "frontier" in z.files else None
+        except Exception as e:
+            raise CheckpointError(
+                f"{path}: checkpoint payload unreadable ({e})"
+            ) from e
+        return values, iteration, frontier

@@ -1,0 +1,322 @@
+"""Serving subsystem: multi-source batching parity, admission control
+(backpressure + deadline shedding), warm-engine pool, LRU result cache,
+session routing, HTTP front end."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine.push import MultiSourcePushExecutor, PushExecutor
+from lux_tpu.graph import generate
+from lux_tpu.models.components import reference_components
+from lux_tpu.models.pagerank import reference_pagerank
+from lux_tpu.models.sssp import SSSP, reference_sssp
+from lux_tpu.obs import metrics
+from lux_tpu.serve import (
+    BadQueryError,
+    DeadlineExceededError,
+    EnginePool,
+    MicroBatcher,
+    QueueFullError,
+    Request,
+    ResultCache,
+    ServeConfig,
+    Session,
+)
+
+
+# -- multi-source micro-batching: the tentpole mechanism ------------------
+
+
+def test_multi_source_sssp_matches_sequential_int():
+    """K roots served in one (nv, K) sweep must be bit-identical to K
+    sequential single-source PushExecutor runs."""
+    g = generate.gnp(500, 3500, seed=101)
+    roots = [0, 3, 77, 401]
+    mx = MultiSourcePushExecutor(g, SSSP(), k=len(roots))
+    state, _ = mx.run(roots)
+    for j, r in enumerate(roots):
+        seq_state, _ = PushExecutor(g, SSSP()).run(start=r)
+        np.testing.assert_array_equal(
+            mx.values_for(state, j), np.asarray(seq_state.values)
+        )
+        np.testing.assert_array_equal(
+            mx.values_for(state, j), reference_sssp(g, r)
+        )
+
+
+def test_multi_source_sssp_matches_sequential_weighted():
+    """Weighted graphs exercise the (ne, 1)-broadcast weight plumbing in
+    the batched relax."""
+    g = generate.gnp(400, 3000, seed=103, weighted=True)
+    roots = [5, 9, 250]
+    mx = MultiSourcePushExecutor(g, SSSP(), k=3)
+    state, _ = mx.run(roots)
+    for j, r in enumerate(roots):
+        seq_state, _ = PushExecutor(g, SSSP()).run(start=r)
+        np.testing.assert_array_equal(
+            mx.values_for(state, j), np.asarray(seq_state.values)
+        )
+
+
+def test_multi_source_pads_short_batches():
+    """Fewer than k roots: lanes are padded by repeating the last root,
+    so results are unchanged and the executable shape is stable (the
+    zero-recompile contract)."""
+    g = generate.gnp(300, 2000, seed=105)
+    mx = MultiSourcePushExecutor(g, SSSP(), k=4)
+    state, _ = mx.run([7])
+    want = reference_sssp(g, 7)
+    for j in range(4):
+        np.testing.assert_array_equal(mx.values_for(state, j), want)
+
+
+def test_multi_source_rejects_bad_widths():
+    g = generate.gnp(50, 200, seed=1)
+    with pytest.raises(ValueError):
+        MultiSourcePushExecutor(g, SSSP(), k=0)
+    mx = MultiSourcePushExecutor(g, SSSP(), k=2)
+    with pytest.raises(ValueError):
+        mx.run([1, 2, 3])   # more roots than lanes
+    with pytest.raises(ValueError):
+        mx.run([])
+
+
+# -- admission control ----------------------------------------------------
+
+
+def _stalled_batcher(max_queue, max_batch=1):
+    """A batcher whose executor blocks until released (deterministic
+    queue-full / deadline scenarios without timing races)."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def execute(batch):
+        started.set()
+        release.wait(10)
+        for r in batch:
+            r.future.set_result("done")
+
+    b = MicroBatcher(execute, max_batch=max_batch, window_s=0.01,
+                     max_queue=max_queue)
+    return b, release, started
+
+
+def test_queue_full_rejects_with_backpressure():
+    """A full admission queue must reject instantly (QueueFullError +
+    counter), never block the producer."""
+    metrics.reset()
+    b, release, started = _stalled_batcher(max_queue=2)
+    try:
+        first = b.submit(Request(app="x", payload=None, batch_key=None))
+        assert started.wait(5), "worker never picked up a request"
+        # Worker is stalled holding `first`; now fill the queue.
+        q1 = b.submit(Request(app="x", payload=None, batch_key=None))
+        q2 = b.submit(Request(app="x", payload=None, batch_key=None))
+        t0 = time.monotonic()
+        with pytest.raises(QueueFullError):
+            b.submit(Request(app="x", payload=None, batch_key=None))
+        assert time.monotonic() - t0 < 1.0, "rejection blocked"
+        assert metrics.counter("lux_serve_rejected_total").value == 1
+        release.set()
+        assert first.result(10) == "done"
+        assert q1.result(10) == "done" and q2.result(10) == "done"
+    finally:
+        release.set()
+        b.close()
+
+
+def test_deadline_expired_requests_are_shed():
+    """Requests whose deadline passed while queued raise
+    DeadlineExceededError and bump the obs counter; fresh requests in
+    the same batch still execute."""
+    metrics.reset()
+    b, release, started = _stalled_batcher(max_queue=8)
+    try:
+        blocker = b.submit(Request(app="x", payload=None, batch_key=None))
+        assert started.wait(5)
+        expired = b.submit(Request(
+            app="x", payload=None, batch_key=None,
+            deadline=time.monotonic() - 0.001,   # already dead
+        ))
+        fresh = b.submit(Request(
+            app="x", payload=None, batch_key=None,
+            deadline=time.monotonic() + 30,
+        ))
+        release.set()
+        with pytest.raises(DeadlineExceededError):
+            expired.result(10)
+        assert fresh.result(10) == "done"
+        assert blocker.result(10) == "done"
+        assert metrics.counter(
+            "lux_serve_deadline_expired_total").value == 1
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_forms_multi_request_batches():
+    """Requests sharing a batch_key inside the window coalesce into one
+    execute() call; a non-matching key ends the batch and leads the
+    next one (FIFO, no starvation)."""
+    sizes = []
+    done = threading.Event()
+
+    def execute(batch):
+        sizes.append([r.payload for r in batch])
+        for r in batch:
+            r.future.set_result(len(batch))
+        if len(sizes) >= 2:
+            done.set()
+
+    b = MicroBatcher(execute, max_batch=8, window_s=0.25, max_queue=32)
+    try:
+        futs = [
+            b.submit(Request(app="s", payload=i, batch_key="A"))
+            for i in range(4)
+        ]
+        other = b.submit(Request(app="s", payload="b0", batch_key="B"))
+        assert done.wait(10)
+        assert futs[0].result(5) == 4      # all four A's in one batch
+        assert other.result(5) == 1
+        assert sizes[0] == [0, 1, 2, 3] and sizes[1] == ["b0"]
+    finally:
+        b.close()
+
+
+# -- pool + cache ---------------------------------------------------------
+
+
+def test_engine_pool_builds_once():
+    metrics.reset()
+    pool = EnginePool()
+    builds = []
+    k = ("push", "fp", "sssp", 1)
+    a = pool.get(k, lambda: builds.append(1) or object())
+    bb = pool.get(k, lambda: builds.append(1) or object())
+    assert a is bb and builds == [1]
+    st = pool.stats()
+    assert st == {"engines": 1, "hits": 1, "misses": 1}
+
+
+def test_result_cache_lru_evicts_oldest():
+    metrics.reset()
+    c = ResultCache(capacity=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1        # refresh a
+    c.put("c", 3)                 # evicts b
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    st = c.stats()
+    assert st["evictions"] == 1 and st["size"] == 2
+
+
+# -- session routing ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    g = generate.gnp(400, 2800, seed=201)
+    cfg = ServeConfig(max_batch=4, window_s=0.25, max_queue=64,
+                      pagerank_iters=4)
+    with Session(g, cfg) as s:
+        yield g, s
+
+
+def test_session_batched_sssp_parity(served):
+    g, s = served
+    roots = [2, 9, 55, 120]
+    futs = [s.submit("sssp", start=r) for r in roots]
+    for f, r in zip(futs, roots):
+        np.testing.assert_array_equal(
+            f.result(60)["values"], reference_sssp(g, r)
+        )
+
+
+def test_session_serves_cached_fixpoints(served):
+    g, s = served
+    pr = s.query("pagerank", timeout=60)
+    np.testing.assert_allclose(
+        pr["values"], reference_pagerank(g, 4), rtol=1e-3, atol=1e-7
+    )
+    before = s.cache.stats()["hits"]
+    s.query("pagerank", timeout=60)
+    assert s.cache.stats()["hits"] == before + 1
+
+
+def test_session_components(served):
+    gd = generate.undirected(generate.gnp(200, 350, seed=205))
+    with Session(gd, ServeConfig(max_batch=2, window_s=0.01)) as s2:
+        out = s2.query("components", timeout=60)
+        np.testing.assert_array_equal(
+            out["values"], reference_components(gd)
+        )
+
+
+def test_session_rejects_bad_queries(served):
+    _, s = served
+    with pytest.raises(BadQueryError):
+        s.submit("no_such_app")
+    with pytest.raises(BadQueryError):
+        s.submit("sssp")                       # missing start
+    with pytest.raises(BadQueryError):
+        s.submit("sssp", start=10**9)          # out of range
+    with pytest.raises(BadQueryError):
+        s.submit("pagerank", ni=0)
+
+
+def test_session_no_rebuild_after_warmup(served):
+    _, s = served
+    misses = s.pool.stats()["misses"]
+    s.query("sssp", start=33, timeout=60)
+    s.query("pagerank", timeout=60)
+    assert s.pool.stats()["misses"] == misses
+
+
+# -- HTTP front end -------------------------------------------------------
+
+
+def test_http_end_to_end():
+    from lux_tpu.serve.http import serve_in_thread
+
+    g = generate.gnp(200, 1200, seed=301)
+    s = Session(g, ServeConfig(max_batch=2, window_s=0.01,
+                               pagerank_iters=3))
+    server, _ = serve_in_thread(s, port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert health["ok"] and health["nv"] == g.nv
+
+        req = urllib.request.Request(
+            base + "/query",
+            json.dumps({"app": "sssp", "start": 5, "full": True}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        np.testing.assert_array_equal(
+            np.asarray(out["values"], np.uint32), reference_sssp(g, 5)
+        )
+
+        bad = urllib.request.Request(
+            base + "/query", json.dumps({"app": "sssp"}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())
+        assert stats["pool"]["misses"] >= 1
+    finally:
+        server.shutdown()
+        s.close()

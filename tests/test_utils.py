@@ -49,6 +49,64 @@ def test_checkpoint_without_frontier(tmp_path):
     assert fr is None and it == 3
 
 
+def test_fingerprint_sees_edge_destinations():
+    # Same nv/ne and identical col_src but different destinations: the
+    # old fingerprint (col_src samples only) collided here, so a resume
+    # or a served cache hit could cross graphs silently.
+    from lux_tpu.graph.graph import Graph
+
+    g1 = Graph.from_edges([0, 0], [1, 2], nv=3)
+    g2 = Graph.from_edges([0, 0], [1, 1], nv=3)
+    np.testing.assert_array_equal(g1.col_src, g2.col_src)
+    f1 = checkpoint.fingerprint(g1)
+    f2 = checkpoint.fingerprint(g2)
+    assert not np.array_equal(f1, f2)
+    assert checkpoint.fingerprint_hex(g1) != checkpoint.fingerprint_hex(g2)
+
+
+def test_fingerprint_deterministic_and_source_sensitive():
+    g = generate.gnp(200, 900, seed=5)
+    np.testing.assert_array_equal(
+        checkpoint.fingerprint(g), checkpoint.fingerprint(g)
+    )
+    h = generate.gnp(200, 900, seed=6)
+    assert checkpoint.fingerprint_hex(g) != checkpoint.fingerprint_hex(h)
+
+
+def test_checkpoint_load_missing_file(tmp_path):
+    g = generate.gnp(20, 50, seed=1)
+    with pytest.raises(checkpoint.CheckpointError, match="does not exist"):
+        checkpoint.load(str(tmp_path / "nope.npz"), g)
+
+
+def test_checkpoint_load_corrupt_file(tmp_path):
+    g = generate.gnp(20, 50, seed=1)
+    p = tmp_path / "bad.npz"
+    p.write_bytes(b"this is not an npz archive")
+    with pytest.raises(checkpoint.CheckpointError, match="not a readable"):
+        checkpoint.load(str(p), g)
+
+
+def test_checkpoint_load_missing_fields(tmp_path):
+    g = generate.gnp(20, 50, seed=1)
+    p = str(tmp_path / "partial.npz")
+    np.savez(p, values=np.zeros(20, np.float32))  # no iteration/fingerprint
+    with pytest.raises(checkpoint.CheckpointError, match="missing"):
+        checkpoint.load(p, g)
+
+
+def test_checkpoint_mismatch_is_checkpoint_error(tmp_path):
+    # CheckpointError subclasses ValueError, so pre-existing callers that
+    # catch ValueError keep working.
+    g1 = generate.gnp(40, 100, seed=1)
+    g2 = generate.gnp(40, 100, seed=2)
+    p = str(tmp_path / "ck.npz")
+    checkpoint.save(p, g1, np.zeros(40, np.float32), 1)
+    with pytest.raises(checkpoint.CheckpointError, match="different graph"):
+        checkpoint.load(p, g2)
+    assert issubclass(checkpoint.CheckpointError, ValueError)
+
+
 def test_timer():
     with Timer() as t:
         sum(range(1000))

@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Closed-loop load generator for the serving layer.
+
+Spawns N worker threads, each issuing queries back-to-back (closed loop)
+or paced to a per-worker QPS budget, against an in-process Session (the
+default: measures engine+batcher latency without socket noise) or a
+remote server via --url (measures the full HTTP path). Prints p50/p99
+latency per app, throughput, and the achieved batch-size histogram from
+the `obs` registry — the evidence format PERF.md specifies for serving
+claims.
+
+Examples:
+  python tools/serve_bench.py --scale 12 --workers 16 --duration 10
+  python tools/serve_bench.py --url http://127.0.0.1:8399 --workers 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def percentile(sorted_xs, q):
+    if not sorted_xs:
+        return 0.0
+    i = min(int(q * len(sorted_xs)), len(sorted_xs) - 1)
+    return sorted_xs[i]
+
+
+class HttpClient:
+    def __init__(self, url):
+        self.url = url.rstrip("/")
+
+    def query(self, payload):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + "/query", json.dumps(payload).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def batch_histogram(self):
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/metrics", timeout=10) as r:
+            snap = json.loads(r.read())["metrics"]
+        for m in snap:
+            if m["name"] == "lux_serve_batch_size":
+                return m
+        return None
+
+
+class LocalClient:
+    def __init__(self, session):
+        self.session = session
+
+    def query(self, payload):
+        payload = dict(payload)
+        app = payload.pop("app")
+        payload.pop("full", None)
+        return self.session.query(app, **payload)
+
+    def batch_histogram(self):
+        from lux_tpu.obs import metrics
+
+        for m in metrics.snapshot():
+            if m["name"] == "lux_serve_batch_size":
+                return m
+        return None
+
+
+def worker(client, mix, nv, stop_at, qps, lat, errs, seed):
+    rng = random.Random(seed)
+    interval = 1.0 / qps if qps else 0.0
+    while time.monotonic() < stop_at:
+        t_next = time.monotonic() + interval
+        app = rng.choices([m[0] for m in mix], [m[1] for m in mix])[0]
+        payload = {"app": app}
+        if app == "sssp":
+            payload["start"] = rng.randrange(nv)
+        t0 = time.perf_counter()
+        try:
+            client.query(payload)
+            lat.setdefault(app, []).append(time.perf_counter() - t0)
+        except Exception as e:
+            errs[type(e).__name__] = errs.get(type(e).__name__, 0) + 1
+        if interval:
+            time.sleep(max(0.0, t_next - time.monotonic()))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", help="benchmark a remote server instead of "
+                   "an in-process session")
+    p.add_argument("--file", help="serve this .lux graph (in-process mode)")
+    p.add_argument("--scale", type=int, default=12,
+                   help="generate an R-MAT graph of this scale "
+                   "(in-process mode without --file)")
+    p.add_argument("--workers", type=int, default=16,
+                   help="concurrent closed-loop clients")
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="per-worker request rate (0 = unpaced closed loop)")
+    p.add_argument("--duration", type=float, default=10.0, help="seconds")
+    p.add_argument("--max-batch", type=int, default=8, dest="max_batch")
+    p.add_argument("--window-ms", type=float, default=3.0, dest="window_ms")
+    p.add_argument("--sssp-weight", type=float, default=0.8,
+                   dest="sssp_weight",
+                   help="fraction of traffic that is SSSP root queries "
+                   "(rest splits between pagerank and components)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON line at the end")
+    args = p.parse_args()
+
+    session = None
+    if args.url:
+        import urllib.request
+
+        client = HttpClient(args.url)
+        health = json.loads(urllib.request.urlopen(
+            args.url.rstrip("/") + "/healthz", timeout=10).read())
+        nv = health["nv"]
+    else:
+        os.environ.setdefault("LUX_PLATFORM", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["LUX_PLATFORM"])
+        from lux_tpu.graph import generate
+        from lux_tpu.serve import ServeConfig, Session
+
+        if args.file:
+            graph = args.file
+        else:
+            graph = generate.rmat(args.scale, 8, seed=1)
+        session = Session(graph, ServeConfig(
+            max_batch=args.max_batch, window_s=args.window_ms / 1e3,
+            max_queue=max(64, 4 * args.workers),
+        ))
+        client = LocalClient(session)
+        nv = session.graph.nv
+
+    w = max(0.0, min(1.0, args.sssp_weight))
+    mix = [("sssp", w), ("pagerank", (1 - w) / 2),
+           ("components", (1 - w) / 2)]
+    lat: dict = {}
+    errs: dict = {}
+    stop_at = time.monotonic() + args.duration
+    threads = [
+        threading.Thread(
+            target=worker,
+            args=(client, mix, nv, stop_at, args.qps, lat, errs, i),
+            daemon=True,
+        )
+        for i in range(args.workers)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+
+    total = sum(len(v) for v in lat.values())
+    print(f"\n{args.workers} workers x {wall:.1f}s  "
+          f"({'closed loop' if not args.qps else f'{args.qps} qps/worker'})"
+          f"  ->  {total} ok ({total / wall:.1f} req/s), errors: "
+          f"{errs or 'none'}")
+    report = {"workers": args.workers, "duration_s": wall,
+              "requests_ok": total, "rps": total / wall, "errors": errs,
+              "apps": {}}
+    for app, xs in sorted(lat.items()):
+        xs.sort()
+        p50, p99 = percentile(xs, 0.50), percentile(xs, 0.99)
+        print(f"  {app:<11} n={len(xs):<6} p50={p50 * 1e3:8.2f} ms   "
+              f"p99={p99 * 1e3:8.2f} ms")
+        report["apps"][app] = {"n": len(xs), "p50_s": p50, "p99_s": p99}
+    hist = client.batch_histogram()
+    if hist:
+        parts = [
+            f"<={b['le']}: {b['count']}"
+            for b in hist["buckets"] if b["count"]
+        ]
+        mean = hist["sum"] / max(hist["count"], 1)
+        print(f"  batches     n={hist['count']} mean_size={mean:.2f}  "
+              f"[{', '.join(parts)}]")
+        report["batch_size"] = {"count": hist["count"], "mean": mean,
+                                "buckets": hist["buckets"]}
+    if args.json:
+        print(json.dumps(report))
+    if session is not None:
+        session.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Serving smoke test (`make serve-smoke`).
+
+End-to-end acceptance run for the serving subsystem (ISSUE 2):
+
+1. generate a tiny graph, write it as .lux, start the HTTP server on an
+   ephemeral port (warm engines compiled before traffic);
+2. issue one PageRank query plus >= 8 concurrent SSSP root queries
+   through the HTTP front end;
+3. validate every SSSP response bit-identical to a sequential
+   single-source PushExecutor run (and the host BFS oracle), and the
+   PageRank response against the numpy oracle;
+4. assert >= 1 multi-source batch of size >= 4 actually formed (via the
+   `obs` lux_serve_batch_size histogram);
+5. assert zero engine builds after warmup (pool miss counter flat across
+   the query phase — i.e. zero recompiles).
+
+Scale with LUX_SMOKE_SCALE (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def post(base, payload, timeout=120):
+    req = urllib.request.Request(
+        base + "/query", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def batch_histogram(base):
+    for m in get(base, "/metrics")["metrics"]:
+        if m["name"] == "lux_serve_batch_size":
+            return m
+    return None
+
+
+def main() -> int:
+    scale = int(os.environ.get("LUX_SMOKE_SCALE", "10"))
+    n_sssp = int(os.environ.get("LUX_SMOKE_QUERIES", "8"))
+
+    os.environ.setdefault("LUX_PLATFORM", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["LUX_PLATFORM"])
+
+    from lux_tpu.engine.push import PushExecutor
+    from lux_tpu.graph import generate, write_lux
+    from lux_tpu.models.pagerank import reference_pagerank
+    from lux_tpu.models.sssp import SSSP, reference_sssp
+    from lux_tpu.serve import ServeConfig, Session
+    from lux_tpu.serve.http import serve_in_thread
+
+    g = generate.rmat(scale, 8, seed=1)
+    ni = 5
+    with tempfile.TemporaryDirectory() as td:
+        gpath = os.path.join(td, f"rmat{scale}.lux")
+        write_lux(gpath, g)
+
+        # Generous window so even a slow CPU box forms one full batch
+        # from the concurrent burst below; real deployments run ~3ms.
+        cfg = ServeConfig(
+            max_batch=max(4, n_sssp), window_s=0.5, max_queue=256,
+            pagerank_iters=ni,
+        )
+        session = Session(gpath, cfg)
+        server, _ = serve_in_thread(session, port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        health = get(base, "/healthz")
+        assert health["ok"] and health["nv"] == g.nv, health
+        print(f"server up: nv={health['nv']} ne={health['ne']} "
+              f"fingerprint={health['fingerprint']}")
+
+        misses_before = get(base, "/stats")["pool"]["misses"]
+        batches_before = (batch_histogram(base) or {"count": 0})["count"]
+
+        # One PageRank + n_sssp concurrent SSSP root queries.
+        rng = np.random.default_rng(7)
+        roots = [int(r) for r in rng.integers(0, g.nv, size=n_sssp)]
+        with ThreadPoolExecutor(max_workers=n_sssp + 1) as tp:
+            pr_fut = tp.submit(post, base, {"app": "pagerank", "ni": ni,
+                                            "full": True})
+            sssp_futs = [
+                tp.submit(post, base, {"app": "sssp", "start": r,
+                                       "full": True})
+                for r in roots
+            ]
+            pr = pr_fut.result()
+            sssp = [f.result() for f in sssp_futs]
+
+        # -- correctness: batched == sequential single-source == oracle --
+        for r, out in zip(roots, sssp):
+            got = np.asarray(out["values"], dtype=np.uint32)
+            ex = PushExecutor(g, SSSP())
+            seq_state, _ = ex.run(start=r)
+            seq = np.asarray(seq_state.values)
+            np.testing.assert_array_equal(got, seq)
+            np.testing.assert_array_equal(got, reference_sssp(g, r))
+        print(f"sssp: {n_sssp} roots bit-identical to sequential "
+              f"single-source runs + oracle")
+
+        pr_got = np.asarray(pr["values"], dtype=np.float32)
+        np.testing.assert_allclose(
+            pr_got, reference_pagerank(g, ni), rtol=1e-3, atol=1e-7
+        )
+        print(f"pagerank: {ni}-iteration fixpoint matches oracle")
+
+        # -- batching actually happened --------------------------------
+        hist = batch_histogram(base)
+        assert hist is not None, "no lux_serve_batch_size histogram"
+        new_big = sum(
+            b["count"] for b in hist["buckets"]
+            if b["le"] == "+Inf" or float(b["le"]) >= 4
+        )
+        assert hist["count"] > batches_before, "no batches formed"
+        assert new_big >= 1, (
+            f"no multi-source batch of size >= 4 formed: {hist['buckets']}"
+        )
+        sizes = [(b["le"], b["count"])
+                 for b in hist["buckets"] if b["count"]]
+        print(f"batching: {hist['count']} batches, histogram {sizes} "
+              f"(>=1 batch of size >=4)")
+
+        # -- zero recompiles after warmup ------------------------------
+        stats = get(base, "/stats")
+        misses_after = stats["pool"]["misses"]
+        assert misses_after == misses_before, (
+            f"engines were built during the query phase: "
+            f"{misses_before} -> {misses_after}"
+        )
+        print(f"warm pool: {stats['pool']['engines']} engines, "
+              f"{stats['pool']['hits']} hits, miss count flat at "
+              f"{misses_after} (zero recompiles after warmup)")
+        if "latency_s" in stats:
+            print(f"latency: p50={stats['latency_s']['p50'] * 1e3:.1f}ms "
+                  f"p99={stats['latency_s']['p99'] * 1e3:.1f}ms over "
+                  f"{stats['latency_s']['count']} requests")
+
+        server.shutdown()
+        session.close()
+    print("serve-smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native test bench obs-smoke serve-smoke serve-bench clean
+.PHONY: all native test bench obs-smoke serve-smoke serve-bench merge-smoke clean
 
 all: native
 
@@ -20,6 +20,9 @@ obs-smoke:
 
 serve-smoke:
 	python tools/serve_smoke.py
+
+merge-smoke:
+	python tools/merge_smoke.py
 
 serve-bench:
 	python tools/serve_bench.py --scale 12 --workers 16 --duration 10

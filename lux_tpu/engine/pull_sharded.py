@@ -34,6 +34,7 @@ from lux_tpu.obs import (
     note_compile_seconds,
     recorder_for,
 )
+from lux_tpu.utils import compat
 from lux_tpu.utils.timing import Timer
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
@@ -101,7 +102,7 @@ class ShardedPullExecutor:
         self._device_graph = sgd
 
         specs = {k: P(PARTS_AXIS) for k in sgd}
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             self._shard_step,
             mesh=self.mesh,
             in_specs=(P(PARTS_AXIS), specs),
@@ -223,7 +224,7 @@ class ShardedPullExecutor:
                 # check_vma off: the all-gathered flat table is
                 # replicated by construction, but the static checker
                 # cannot infer it here.
-                return jax.jit(jax.shard_map(
+                return jax.jit(compat.shard_map(
                     fn, mesh=self.mesh, in_specs=in_specs,
                     out_specs=out_specs, check_vma=False,
                 ))

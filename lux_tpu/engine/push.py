@@ -56,6 +56,7 @@ from lux_tpu.obs import (
 from lux_tpu.ops.segment import identity_for, segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
 from lux_tpu.parallel.shard import ShardedGraph
+from lux_tpu.utils import compat
 from lux_tpu.utils.timing import Timer
 
 class PushProgram:
@@ -968,7 +969,7 @@ class ShardedPushExecutor:
         self._specs = {k: P(PARTS_AXIS) for k in self._dg}
         self.sparse_iters = 0       # sparse-branch count of the last run()
         state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             self._shard_step,
             mesh=self.mesh,
             in_specs=(state_spec, self._specs),
@@ -1172,7 +1173,7 @@ class ShardedPushExecutor:
     def _multi(self, state: PushState, limit: int, k: int):
         if k not in self._chunk_cache:
             state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
-            mapped = jax.shard_map(
+            mapped = compat.shard_map(
                 lambda st, dg, lim: self._shard_chunk(st, dg, lim, k),
                 mesh=self.mesh,
                 in_specs=(state_spec, self._specs, P()),
@@ -1223,7 +1224,7 @@ class ShardedPushExecutor:
         def sm(fn, in_specs, out_specs):
             # check_vma off: all_gather outputs are replicated by
             # construction but the static checker cannot infer it here.
-            mapped = jax.shard_map(
+            mapped = compat.shard_map(
                 fn, mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs, check_vma=False,
             )

@@ -135,6 +135,171 @@ def derive_level_slots(runs, f, items):
     return out
 
 
+def _align_up(x: int, a: int) -> int:
+    return -(-x // a) * a if a > 1 else x
+
+
+def schedule_grouped(runs, align_rows: int = 1):
+    """Copy-window (round-5) scheduler: the per-row generalization of
+    :func:`schedule` that the production planner vectorizes.
+
+    Instead of deriving every level from one global final-slot walk with
+    64-per-side window quotas, each level is scheduled independently,
+    bottom-up, against the per-row kernel contract: output row o reads
+    ONE full 128-lane input row per side (scalar-prefetched ``arow[o]``,
+    ``brow[o]``) and an int8 code plane routes lanes (v >= 0 side A
+    lane v, v < 0 side B lane v & 127). A row whose codes are
+    single-sided is a COPY row — a drained or dominant side streams at
+    full rate (128/row) instead of stalling at the 64/64 merge rate,
+    which is the entire point (PERF.md: 1.85x -> target <1.5x). The
+    walk emits a copy row exactly when the next <=128 merged reals are
+    single-sided within one input row.
+
+    A row closes when it holds 128 reals or when the merged order
+    needs a real from an input row other than the one the row reads
+    for that side (the only stall source left). ``align_rows`` pads
+    every leaf/node stream base to that many rows (the Mosaic 8-row
+    block constraint; the planner adds remainder bin-packing on top).
+
+    Returns ``(levels, final_items, total_rows)``: ``levels[k]`` is a
+    dict of numpy arrays {arow, brow, codes, nvalid, mode} for merge
+    level k+1 (mode 0 merge, 1 copy-A, 2 copy-B), ``final_items`` the
+    reals as (dst, run, pos, slot) in merged order, ``total_rows`` the
+    per-level stream row counts [level0, ..., root].
+    """
+    R = _tree_size(len(runs))
+    L = R.bit_length() - 1
+
+    # Leaf streams: run r dense from an aligned base.
+    streams = []
+    base = 0
+    for r in range(R):
+        a = np.asarray(runs[r]) if r < len(runs) else np.empty(0, np.int64)
+        streams.append([
+            (int(d), r, p, base + p // BLOCK, p % BLOCK)
+            for p, d in enumerate(a)
+        ])
+        base = _align_up(base + (len(a) + BLOCK - 1) // BLOCK, align_rows)
+    total_rows = [base]
+
+    levels = []
+    for lev in range(1, L + 1):
+        arow, brow, codes, nvalid, mode = [], [], [], [], []
+        out_streams = []
+        ob = 0
+        for node in range(R >> lev):
+            A, B = streams[2 * node], streams[2 * node + 1]
+            out = []
+            ia = ib = 0
+            while ia < len(A) or ib < len(B):
+                ra = A[ia][3] if ia < len(A) else -1
+                rb = B[ib][3] if ib < len(B) else -1
+                row_codes = np.zeros(BLOCK, np.int8)
+                count = 0
+                took_a = took_b = False
+                while count < BLOCK:
+                    ta = A[ia] if ia < len(A) else None
+                    tb = B[ib] if ib < len(B) else None
+                    if ta is None and tb is None:
+                        break
+                    # Merged order: (dst, run) — side A holds the lower
+                    # run ids of the node, so ties go to A.
+                    use_a = tb is None or (
+                        ta is not None and ta[:2] <= tb[:2]
+                    )
+                    if use_a:
+                        if ta[3] != ra:
+                            break          # next A real is in a later row
+                        row_codes[count] = ta[4]
+                        out.append((ta[0], ta[1], ta[2], ob, count))
+                        ia += 1
+                        took_a = True
+                    else:
+                        if tb[3] != rb:
+                            break
+                        row_codes[count] = tb[4] - BLOCK
+                        out.append((tb[0], tb[1], tb[2], ob, count))
+                        ib += 1
+                        took_b = True
+                    count += 1
+                arow.append(ra if took_a else max(rb, 0))
+                brow.append(rb if took_b else max(ra, 0))
+                codes.append(row_codes)
+                nvalid.append(count)
+                mode.append(0 if (took_a and took_b) else (1 if took_a else 2))
+                ob += 1
+            out_streams.append(out)
+            # Materialize alignment gap rows so row ids stay physical
+            # (nvalid 0: pure pads, contributing nothing).
+            while ob != _align_up(ob, align_rows):
+                arow.append(0)
+                brow.append(0)
+                codes.append(np.zeros(BLOCK, np.int8))
+                nvalid.append(0)
+                mode.append(0)
+                ob += 1
+        levels.append({
+            "arow": np.asarray(arow, np.int32),
+            "brow": np.asarray(brow, np.int32),
+            "codes": (np.stack(codes) if codes
+                      else np.zeros((0, BLOCK), np.int8)),
+            "nvalid": np.asarray(nvalid, np.int32),
+            "mode": np.asarray(mode, np.int8),
+        })
+        total_rows.append(ob)
+        streams = out_streams
+
+    final_items = [
+        (d, r, p, row * BLOCK + lane) for d, r, p, row, lane in streams[0]
+    ]
+    return levels, final_items, total_rows
+
+
+def simulate_grouped(runs, values, align_rows: int = 1):
+    """Execute the copy-window network with the per-row kernel's exact
+    semantics and return (final_stream, final_items).
+
+    Asserts the device contract at every level: codes may only address
+    lanes that hold reals (pads are never referenced, so intermediate
+    pad lanes can stay garbage on device; only the root is masked by
+    ``nvalid``), and the final stream is globally dst-sorted.
+    """
+    levels, final_items, total_rows = schedule_grouped(runs, align_rows)
+    R = _tree_size(len(runs))
+
+    cur = np.zeros((max(total_rows[0], 1), BLOCK), np.float64)
+    valid = np.zeros_like(cur, bool)
+    base = 0
+    for r in range(R):
+        a = runs[r] if r < len(runs) else ()
+        for p in range(len(a)):
+            cur[base + p // BLOCK, p % BLOCK] = values[r][p]
+            valid[base + p // BLOCK, p % BLOCK] = True
+        base = _align_up(base + (len(a) + BLOCK - 1) // BLOCK, align_rows)
+
+    for k, lv in enumerate(levels):
+        lane = lv["codes"].astype(np.int64) & 127
+        is_a = lv["codes"] >= 0
+        src_row = np.where(is_a, lv["arow"][:, None], lv["brow"][:, None])
+        nxt = cur[src_row, lane]
+        nvalid = lv["nvalid"]
+        iota = np.arange(BLOCK)
+        live = iota[None, :] < nvalid[:, None]
+        # Contract: every live code addresses a real input lane.
+        assert np.all(valid[src_row, lane][live]), (
+            "grouped level references a pad lane", k + 1)
+        nxt = np.where(live, nxt, 0.0)
+        nrows = max(total_rows[k + 1], 1)
+        cur = np.zeros((nrows, BLOCK), np.float64)
+        cur[: nxt.shape[0]] = nxt
+        valid = np.zeros_like(cur, bool)
+        valid[: nxt.shape[0]] = live
+
+    dsts = [d for d, _, _, _ in final_items]
+    assert all(a <= b for a, b in zip(dsts, dsts[1:])), "dst order broken"
+    return cur, final_items
+
+
 def simulate(runs, values):
     """Execute the network in numpy with the DEVICE KERNEL's semantics
     and return the final stream (values at final slots, zeros at pads).

@@ -1095,11 +1095,22 @@ def tail_sum(x2d: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
     )
 
 
-def hybrid_spmv(vals: jnp.ndarray, dh: DeviceHybrid) -> jnp.ndarray:
+def hybrid_spmv(
+    vals: jnp.ndarray, dh: DeviceHybrid, gtail=None
+) -> jnp.ndarray:
     """Full Σ vals[src] per destination over all layouts; (nv,) f32 in,
-    (nv,) f32 out (internal vertex order)."""
+    (nv,) f32 out (internal vertex order).
+
+    ``gtail`` (a :class:`~lux_tpu.ops.merge_tail_kernel.DeviceGroupedTail`)
+    swaps the lane-select tail for the grouped merge-network tail —
+    opt-in via LUX_GROUPED_TAIL=1 in the executors; both produce per-dst
+    sums of the same tail edge set."""
     nv = vals.shape[0]
     x2d = vals_to_x2d(vals, dh)
+    if gtail is not None:
+        from lux_tpu.ops.merge_tail_kernel import grouped_tail_sums
+
+        return strips_sum(x2d, dh, nv) + grouped_tail_sums(x2d, gtail)
     return strips_sum(x2d, dh, nv) + tail_sum(x2d, dh)
 
 

@@ -1,0 +1,31 @@
+"""jax version compatibility shims.
+
+The engines are written against the current jax API (``jax.shard_map``
+with ``check_vma=``); older releases only ship
+``jax.experimental.shard_map.shard_map`` with the equivalent knob spelled
+``check_rep``. This module resolves the difference once so every call
+site can stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on jax builds that have it; otherwise the
+    experimental entry point. The legacy ``check_rep`` checker predates
+    replication rules for ``while``/``scan`` and rejects the fused
+    iteration loops outright, so the fallback always disables it —
+    the varying-axis check is a static lint, not a semantics change."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )

@@ -75,3 +75,209 @@ def test_degenerate_single_and_empty_runs():
     np.testing.assert_allclose(final[f], [1.0, 2.0, 3.0])
     final, f, items = simulate([], [])
     assert len(items) == 0 and final.shape[0] >= BLOCK
+
+
+# -- round-5 copy-window scheduler + production planner ------------------
+
+from lux_tpu.ops.merge_tail_ref import (      # noqa: E402
+    _align_up,
+    _tree_size,
+    schedule_grouped,
+    simulate_grouped,
+)
+
+
+@pytest.mark.parametrize("seed,align", [
+    (0, 1), (1, 1), (2, 8), (3, 8), (4, 1), (5, 8),
+])
+def test_grouped_schedule_end_to_end(seed, align):
+    rng = np.random.default_rng(seed)
+    runs, values = random_runs(rng, int(rng.integers(1, 12)), 60, 25)
+    final, items = simulate_grouped(runs, values, align_rows=align)
+    # simulate_grouped asserts the kernel contract (codes only address
+    # real lanes) and global dst order internally.
+    got = {(r, p): final[row, lane] for _, r, p, row, lane in (
+        (d, r, p, s // BLOCK, s % BLOCK) for d, r, p, s in items)}
+    for r, vs in enumerate(values):
+        for p, v in enumerate(vs):
+            assert got[(r, p)] == v
+
+
+def test_grouped_copy_rows_stream_at_full_rate():
+    # Two runs over disjoint dst ranges: after the first run drains,
+    # every remaining row must be a single-sided copy row carrying a
+    # full 128 reals (not the 64/64 merge rate).
+    a = np.zeros(64, np.int64)                 # run 0: all dst 0
+    b = np.full(512, 1, np.int64)              # run 1: all dst 1, larger
+    levels, items, rows = schedule_grouped([a, b])
+    lv = levels[0]
+    copy_b = (lv["mode"] == 2) & (lv["nvalid"] == BLOCK)
+    assert copy_b.sum() >= 3, lv["mode"]       # 512/128 - boundary row
+
+
+def test_planner_matches_reference_planes():
+    from lux_tpu.ops import merge_tail_plan as mtp
+
+    def ref_leaf_layout(runs, align):
+        R = _tree_size(len(runs))
+        recs = []
+        base = 0
+        for r in range(R):
+            a = (np.asarray(runs[r]) if r < len(runs)
+                 else np.empty(0, np.int64))
+            for p, d in enumerate(a):
+                recs.append((int(d), r, base + p // BLOCK, p % BLOCK))
+            base = _align_up(
+                base + (len(a) + BLOCK - 1) // BLOCK, align)
+        recs.sort()
+        if not recs:
+            z = np.zeros(0, np.int64)
+            return z, z, z, z
+        d, leaf, row, lane = map(np.asarray, zip(*recs))
+        return d, leaf, row, lane
+
+    for seed in range(6):
+        rng = np.random.default_rng(seed)
+        runs, _ = random_runs(rng, int(rng.integers(1, 10)), 40, 30)
+        for align in (1, 8):
+            ref_levels, ref_items, ref_rows = schedule_grouped(runs, align)
+            d, leaf, row, lane = ref_leaf_layout(runs, align)
+            levels, frow, flane, rows = mtp.plan_merge_network(
+                d, leaf, row, lane, len(runs), align_rows=align)
+            assert rows == ref_rows[1:]
+            for lv, rlv in zip(levels, ref_levels):
+                for key in ("arow", "brow", "codes", "nvalid", "mode"):
+                    np.testing.assert_array_equal(lv[key], rlv[key])
+            ref_slots = np.asarray([s for *_, s in ref_items])
+            np.testing.assert_array_equal(frow * BLOCK + flane, ref_slots)
+
+
+def _random_tail(rng, nsb, nv, m):
+    """A synthetic hybrid-plan tail: (sb, lane, row_ptr) in CSC order."""
+    sb = rng.integers(0, nsb, size=m)
+    lane = rng.integers(0, BLOCK, size=m)
+    dst = np.sort(rng.integers(0, nv, size=m))
+    row_ptr = np.searchsorted(dst, np.arange(nv + 1))
+    return sb, lane, row_ptr, dst
+
+
+@pytest.mark.parametrize("nsb,nv,m", [(6, 40, 400), (48, 700, 15000),
+                                      (3, 5, 0)])
+def test_grouped_tail_plan_bitwise_sums(nsb, nv, m):
+    # Integral source values keep every f32 addition exact, so the
+    # grouped network's per-dst sums must be BITWISE equal to the
+    # scatter oracle regardless of addend order.
+    import jax
+    import jax.numpy as jnp
+
+    from lux_tpu.ops import merge_tail_plan as mtp
+    from lux_tpu.ops.merge_tail_kernel import (
+        DeviceGroupedTail,
+        grouped_tail_sums,
+    )
+
+    rng = np.random.default_rng(nsb * 1000 + m)
+    sb, lane, row_ptr, dst = _random_tail(rng, nsb, nv, m)
+    plan = mtp.plan_grouped_tail(sb, lane, row_ptr)
+    gt = DeviceGroupedTail.build(plan)
+    x2d = rng.integers(-40, 40, size=(nsb, BLOCK)).astype(np.float32)
+    got = np.asarray(jax.jit(grouped_tail_sums)(jnp.asarray(x2d), gt))
+    want = np.zeros(nv, np.float64)
+    np.add.at(want, dst, x2d[sb, lane].astype(np.float64))
+    np.testing.assert_array_equal(got, want.astype(np.float32))
+
+
+def test_grouped_plan_cache_roundtrip(tmp_path):
+    from lux_tpu.ops import merge_tail_plan as mtp
+
+    rng = np.random.default_rng(9)
+    sb, lane, row_ptr, _ = _random_tail(rng, 20, 300, 5000)
+    plan = mtp.plan_grouped_tail(sb, lane, row_ptr)
+    path = str(tmp_path / "gtail.luxplan")
+    mtp.save_grouped_plan(path, plan)
+    loaded = mtp.load_grouped_plan(path)
+    for name in mtp._PLAN_ARRAYS:
+        np.testing.assert_array_equal(
+            getattr(plan, name), getattr(loaded, name))
+    assert loaded.n_edges == plan.n_edges
+    assert loaded.stats == plan.stats
+    # Overwrite must replace, not merge.
+    mtp.save_grouped_plan(path, plan)
+    assert mtp.load_grouped_plan(path).n_edges == plan.n_edges
+
+
+def test_hybrid_spmv_grouped_tail_parity():
+    # Full hybrid_spmv: the grouped tail and the lane-select tail must
+    # produce BITWISE-identical per-dst sums on integral values (every
+    # per-dst total < 2^24, so f32 addition is exact in any order).
+    import jax.numpy as jnp
+
+    from lux_tpu.graph.generate import rmat
+    from lux_tpu.ops import merge_tail_plan as mtp
+    from lux_tpu.ops.merge_tail_kernel import DeviceGroupedTail
+    from lux_tpu.ops.tiled_spmv import (
+        DeviceHybrid,
+        hybrid_spmv,
+        plan_hybrid,
+    )
+
+    g = rmat(11, 12, seed=5)
+    plan = plan_hybrid(g, levels=((8, 2),))
+    dh = DeviceHybrid.build(plan, chunk_strips=16, chunk_tail=64)
+    gplan = mtp.plan_grouped_tail(
+        plan.tail_sb, plan.tail_lane, plan.tail_row_ptr)
+    gt = DeviceGroupedTail.build(gplan)
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(
+        rng.integers(0, 8, size=g.nv).astype(np.float32))
+    base = np.asarray(hybrid_spmv(vals, dh))
+    grouped = np.asarray(hybrid_spmv(vals, dh, gt))
+    np.testing.assert_array_equal(base, grouped)
+
+
+def test_executor_grouped_tail_pagerank_parity(monkeypatch):
+    # End-to-end through TiledPullExecutor: LUX_GROUPED_TAIL=1 PageRank
+    # matches the lane-select run to f32 summation-order noise.
+    from lux_tpu.engine.tiled import TiledPullExecutor
+    from lux_tpu.graph.generate import rmat
+    from lux_tpu.models.pagerank import PageRank
+
+    g = rmat(10, 14, seed=3)
+    ex0 = TiledPullExecutor(g, PageRank(), chunk_strips=16, chunk_tail=64)
+    monkeypatch.setenv("LUX_GROUPED_TAIL", "1")
+    ex1 = TiledPullExecutor(g, PageRank(), chunk_strips=16, chunk_tail=64)
+    assert ex0.gtail is None and ex1.gtail is not None
+    assert ex1.gtail_stats["n_edges"] == ex1.plan.tail_sb.shape[0]
+    v0 = np.asarray(ex0.run(8))
+    v1 = np.asarray(ex1.run(8))
+    np.testing.assert_allclose(v0, v1, rtol=1e-5, atol=1e-8)
+    # Per-level timed phase path reports one entry per network level.
+    out, times = ex1.phase_step(ex1.init_values())
+    nlev = ex1.gtail.n_levels
+    assert all(f"tail_level{k}" in times for k in range(nlev + 1))
+
+
+@pytest.mark.slow
+def test_planner_scales_to_a_million_reals():
+    # Acceptance: a >= 1M-real heavy-tailed stream plans in seconds.
+    import time
+
+    from lux_tpu.ops import merge_tail_plan as mtp
+
+    rng = np.random.default_rng(2)
+    nsb = 1024
+    sizes = np.minimum(
+        rng.lognormal(6.4, 1.3, size=nsb).astype(np.int64) + 1, 79237)
+    m = int(sizes.sum())
+    assert m >= 1_000_000
+    sb = np.repeat(np.arange(nsb), sizes)
+    nv = 1 << 17
+    dst = np.sort(rng.integers(0, nv, size=m))
+    sb = sb[np.lexsort((sb, dst))]
+    lane = rng.integers(0, BLOCK, size=m)
+    row_ptr = np.searchsorted(dst, np.arange(nv + 1))
+    t0 = time.perf_counter()
+    plan = mtp.plan_grouped_tail(sb, lane, row_ptr)
+    dt = time.perf_counter() - t0
+    assert dt < 60, dt
+    assert plan.stats["mean_inflation"] < 1.5, plan.stats

@@ -83,3 +83,51 @@ def test_segment_minmax_blockmin_fuzz():
                     lay.tail_segs, kind,
                 ))
                 np.testing.assert_array_equal(got, want)
+
+
+def test_rowptr_sum_empty_and_single_element_segments():
+    # Deterministic layout: empty segments at the start, middle, and end,
+    # plus single-element runs — boundary diff must give exact zeros for
+    # empties and the lone element for singletons.
+    row_ptr = np.array([0, 0, 1, 1, 4, 5, 5], np.int64)
+    data = np.array([10.0, 1.0, 2.0, 3.0, -7.0], np.float32)
+    got = np.asarray(seg.segment_sum_by_rowptr(jnp.asarray(data), row_ptr))
+    np.testing.assert_array_equal(
+        got, np.array([0.0, 10.0, 0.0, 6.0, -7.0, 0.0], np.float32))
+
+
+def test_rowptr_sum_no_edges_at_all():
+    row_ptr = np.zeros(8, np.int64)
+    got = np.asarray(seg.segment_sum_by_rowptr(
+        jnp.asarray(np.zeros(0, np.float32)), row_ptr))
+    np.testing.assert_array_equal(got, np.zeros(7, np.float32))
+
+
+def test_blockmin_head_tail_at_block_boundaries():
+    # Segments chosen to pin every head/tail extraction case of
+    # BlockMinLayout exactly at 128-lane block edges: a full aligned
+    # block, a singleton at the last lane of a block, a singleton at the
+    # first lane of the next one, a straddler, an empty segment between
+    # them, and a tail ending mid-block.
+    from lux_tpu.ops.segment import BlockMinLayout, segment_minmax_blockmin
+
+    bounds = [0, 128, 255, 256, 258, 258, 300]   # nv = 6 segments
+    rp = np.asarray(bounds, np.int64)
+    ne = int(rp[-1])
+    nep = -(-ne // 128) * 128
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 1 << 24, ne).astype(np.uint32)
+    for kind in ("min", "max"):
+        ident = np.uint32(0xFFFFFFFF) if kind == "min" else np.uint32(0)
+        padded = np.full(nep, ident, np.uint32)
+        padded[:ne] = data
+        want = np.array([
+            getattr(data[s:e], kind)() if e > s else ident
+            for s, e in zip(bounds[:-1], bounds[1:])
+        ], np.uint32)
+        for seg_rows in (0, 1):
+            lay = BlockMinLayout(rp, nep, seg_rows=seg_rows)
+            la = {k: jnp.asarray(v) for k, v in lay.device_arrays().items()}
+            got = np.asarray(segment_minmax_blockmin(
+                jnp.asarray(padded), la, lay.head_segs, lay.tail_segs, kind))
+            np.testing.assert_array_equal(got, want)

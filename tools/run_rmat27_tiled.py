@@ -51,9 +51,9 @@ def main():
 
     log(f"platform: {ensure_backend()}")
 
-    import jax
     import numpy as np
 
+    from lux_tpu.engine.pull import hard_sync
     from lux_tpu.engine.tiled_sharded import ShardedTiledExecutor
     from lux_tpu.graph import read_lux_mmap
     from lux_tpu.models.pagerank import ALPHA, PageRank
@@ -103,7 +103,7 @@ def main():
     # (reported separately, like tools/run_rmat27.py's steady mean).
     t0 = time.time()
     vals = ex.step(vals)
-    jax.block_until_ready(vals)
+    hard_sync(vals)
     compile_step = time.time() - t0
     log(f"first step (compile + run) in {compile_step:.0f}s")
     new_full = ex.gather_values(vals)
@@ -123,7 +123,7 @@ def main():
     for it in range(2, args.ni + 1):
         t0 = time.time()
         vals = ex.step(vals)
-        jax.block_until_ready(vals)
+        hard_sync(vals)
         dt = time.time() - t0
         iter_times.append(dt)
         new_full = ex.gather_values(vals)

@@ -2,15 +2,20 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native test bench obs-smoke serve-smoke serve-bench merge-smoke clean
+.PHONY: all native lint test verify bench obs-smoke serve-smoke serve-bench merge-smoke clean
 
 all: native
 
 native:
 	python -c "from lux_tpu.native.build import load_library; load_library(); print('native library ready')"
 
+lint:
+	python tools/luxlint.py
+
 test:
 	python -m pytest tests/ -q
+
+verify: lint test
 
 bench:
 	python bench.py

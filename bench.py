@@ -283,24 +283,24 @@ def bench_cf(g, iters: int = 5):
 
 def main():
     t_start = time.monotonic()
-    scale = int(os.environ.get("LUX_BENCH_SCALE", "22"))
-    ef = int(os.environ.get("LUX_BENCH_EF", "16"))
-    iters = int(os.environ.get("LUX_BENCH_ITERS", "50"))
-    cache = os.environ.get(
-        "LUX_BENCH_CACHE",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".bench_cache"),
+    from lux_tpu.utils import flags
+
+    scale = flags.get_int("LUX_BENCH_SCALE")
+    ef = flags.get_int("LUX_BENCH_EF")
+    iters = flags.get_int("LUX_BENCH_ITERS")
+    cache = flags.get("LUX_BENCH_CACHE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_cache"
     )
-    layout = os.environ.get("LUX_BENCH_LAYOUT", "tiled")
+    layout = flags.get("LUX_BENCH_LAYOUT")
     if layout not in ("tiled", "flat"):
         raise SystemExit(f"LUX_BENCH_LAYOUT must be tiled|flat, got {layout!r}")
-    budget = int(os.environ.get("LUX_BENCH_TILE_MB", "8192")) << 20
+    budget = flags.get_int("LUX_BENCH_TILE_MB") << 20
     levels = tuple(
         tuple(int(v) for v in part.split("/"))
-        for part in os.environ.get("LUX_BENCH_LEVELS", "8/2").split(",")
+        for part in flags.get("LUX_BENCH_LEVELS").split(",")
     )
-    run_suite = os.environ.get("LUX_BENCH_SUITE", "1") != "0"
-    deadline = float(os.environ.get("LUX_BENCH_DEADLINE", "480"))
+    run_suite = flags.get_bool("LUX_BENCH_SUITE")
+    deadline = flags.get_float("LUX_BENCH_DEADLINE")
 
     from lux_tpu.utils.platform import ensure_backend
 

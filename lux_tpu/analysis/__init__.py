@@ -1,0 +1,27 @@
+"""Project-native static analysis (luxlint) + runtime discipline sentinels.
+
+Static side (stdlib-only, no jax import — ``tools/luxlint.py`` must lint
+the tree in well under a second per file):
+
+- :mod:`lux_tpu.analysis.core` — rule engine: ``Rule``/``Finding``,
+  inline ``# luxlint: disable=RULE`` suppressions, JSON + human output;
+- :mod:`lux_tpu.analysis.rules` — the rule set targeting this repo's
+  real failure modes (host syncs in engine hot loops, recompile hygiene,
+  kernel BlockSpec layout contracts, the LUX_* env-flag registry).
+
+Runtime side (imports jax; import it lazily):
+
+- :mod:`lux_tpu.analysis.sentinel` — ``RecompileSentinel`` (per-key XLA
+  compile counts; serve/pool.py's zero-recompiles-after-warmup evidence)
+  and ``HostTransferGuard`` (fails tests that device-transfer inside a
+  guarded iteration region).
+"""
+
+from lux_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintReport,
+    Rule,
+    run_paths,
+    run_source,
+)
+from lux_tpu.analysis.rules import all_rules  # noqa: F401

@@ -1,0 +1,454 @@
+"""The luxlint rule set — this repo's real failure modes, machine-checked.
+
+Each rule encodes an invariant the performance story depends on but the
+code previously only promised in prose:
+
+- LUX001 host-sync-in-hot-loop: Gunrock-style frontier/iteration loops
+  are fast only while no hidden host round-trip sits inside them (a
+  single ``.item()`` per iteration serializes the whole async dispatch
+  pipeline — PERF.md measured 620 vs 316 ms/iter for dispatch-per-step
+  vs fused).
+- LUX002 recompile-hygiene: jitted steps must donate their buffer
+  argument (else HBM holds two copies) and jitted callables must not be
+  fed bare Python scalars (each distinct value retraces).
+- LUX003 kernel-shape-contract: Pallas BlockSpecs must honor the plan
+  layout rules from ops/merge_tail_plan.py — 128-lane blocks, rows in
+  Mosaic 8-row units (or single-row scalar-prefetch form), int8 code
+  planes, int32 row indices.
+- LUX004 env-flag-registry: every ``LUX_*`` key read anywhere must be
+  declared in lux_tpu/utils/flags.py.
+- LUX005 direct-env-read: lux_tpu code reads LUX_* knobs through the
+  flags module, not os.environ (writes — CLI flag plumbing,
+  subprocess setup — stay legal).
+
+All pure ``ast``; no jax, no numpy.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from lux_tpu.analysis.core import FileContext, Finding, Rule
+
+# Functions that ARE the iteration hot path. Deliberately narrow: warmup
+# and phase_step sync per dispatch by design.
+_HOT_FN_RE = re.compile(r"(^|_)run(_|$)|fixpoint|pipelined")
+# jit'd callables that carry the iteration state buffer.
+_STEP_FN_RE = re.compile(r"(^|_)(step|run)")
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.device_get' for Attribute chains, 'float' for Names."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root_ident(node: ast.AST) -> Optional[str]:
+    """Nearest meaningful identifier of an expression: the value a call
+    like ``x.codes.astype(...)`` is really about ('codes')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _root_ident(node.value)
+    if isinstance(node, ast.Call):
+        if node.args:
+            return _root_ident(node.args[0])
+        return _root_ident(node.func)
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class HostSyncInHotLoop(Rule):
+    id = "LUX001"
+    title = "host-sync-in-hot-loop"
+    doc = ("no host transfer/sync (.item(), float(), np.asarray, "
+           "device_get, block_until_ready, hard_sync) inside engine "
+           "run/fixpoint loops")
+
+    _SYNC_CALLS = {"jax.device_get", "device_get", "hard_sync"}
+    _ASARRAY = {"np.asarray", "numpy.asarray", "onp.asarray"}
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return "engine/" in ctx.posix_path
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: Dict[tuple, Finding] = {}
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _HOT_FN_RE.search(fn.name):
+                continue
+            host_names = self._host_tainted(fn)
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    f = self._check_call(node, fn.name, host_names, ctx)
+                    if f is not None:
+                        out[(f.line, f.col)] = f
+        return out.values()
+
+    def _host_tainted(self, fn: ast.AST) -> Set[str]:
+        """Names holding already-fetched host values: assigned (possibly
+        transitively) from a device_get result. Converting those again
+        (int()/np.asarray()) is free — don't flag it."""
+        assigns = sorted(
+            (n for n in ast.walk(fn)
+             if isinstance(n, (ast.Assign, ast.AugAssign))),
+            key=lambda n: n.lineno,
+        )
+        tainted: Set[str] = set()
+        for a in assigns:
+            rhs = a.value
+            from_get = any(
+                isinstance(c, ast.Call)
+                and _dotted(c.func) in self._SYNC_CALLS
+                for c in ast.walk(rhs)
+            )
+            if not (from_get or (_names_in(rhs) & tainted)):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                tainted.update(
+                    e.id for e in elts if isinstance(e, ast.Name)
+                )
+        return tainted
+
+    def _arg_is_host(self, arg: ast.AST, host_names: Set[str]) -> bool:
+        if isinstance(arg, ast.Constant):
+            return True
+        if _names_in(arg) & host_names:
+            return True
+        # np.asarray(jax.device_get(x)): the inner sync is the finding.
+        return any(
+            isinstance(c, ast.Call) and _dotted(c.func) in self._SYNC_CALLS
+            for c in ast.walk(arg)
+        )
+
+    def _check_call(self, node, fn_name, host_names, ctx):
+        if not isinstance(node, ast.Call):
+            return None
+        name = _dotted(node.func)
+        if name in self._SYNC_CALLS or (
+            name is not None and name.endswith("block_until_ready")
+        ):
+            return self.finding(
+                ctx, node,
+                f"`{name}` inside hot loop of `{fn_name}` stalls the "
+                "device pipeline; hoist it out of the loop or suppress "
+                "with a reason",
+            )
+        if name in self._ASARRAY and node.args and not self._arg_is_host(
+            node.args[0], host_names
+        ):
+            return self.finding(
+                ctx, node,
+                f"`{name}` on a device value inside hot loop of "
+                f"`{fn_name}` forces a device->host transfer per "
+                "iteration",
+            )
+        if name in ("float", "int") and len(node.args) == 1 and \
+                not self._arg_is_host(node.args[0], host_names):
+            return self.finding(
+                ctx, node,
+                f"`{name}()` on a device value inside hot loop of "
+                f"`{fn_name}` blocks on the device per iteration",
+            )
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args and \
+                not self._arg_is_host(node.func.value, host_names):
+            return self.finding(
+                ctx, node,
+                f"`.item()` inside hot loop of `{fn_name}` is a "
+                "synchronous device->host scalar read per iteration",
+            )
+        return None
+
+
+class RecompileHygiene(Rule):
+    id = "LUX002"
+    title = "recompile-hygiene"
+    doc = ("jitted buffer-carrying steps need donate_argnums; jitted "
+           "callables must not be fed bare Python scalars")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # binding name -> True when the jit has static_argnums/argnames
+        # (scalar args are then legitimately static).
+        jit_bindings: Dict[str, bool] = {}
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _dotted(node.value.func) in ("jax.jit", "jit"):
+                out.extend(self._check_jit_call(node.value, ctx))
+                has_static = self._has_kw(
+                    node.value, "static_argnums", "static_argnames"
+                )
+                for t in node.targets:
+                    bind = t.id if isinstance(t, ast.Name) else (
+                        t.attr if isinstance(t, ast.Attribute) else None
+                    )
+                    if bind is not None:
+                        jit_bindings[bind] = has_static
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dec_call = dec if isinstance(dec, ast.Call) else None
+                    name = _dotted(dec_call.func if dec_call else dec)
+                    if name in ("jax.jit", "jit") and _STEP_FN_RE.search(
+                        node.name
+                    ) and not (
+                        dec_call is not None and self._has_kw(
+                            dec_call, "donate_argnums", "donate_argnames"
+                        )
+                    ):
+                        out.append(self.finding(
+                            ctx, dec,
+                            f"@jit on buffer-carrying `{node.name}` "
+                            "without donate_argnums keeps the old buffer "
+                            "live (2x HBM for the state)",
+                        ))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            bind = None
+            if isinstance(node.func, ast.Name):
+                bind = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                bind = node.func.attr
+            if bind not in jit_bindings or jit_bindings[bind]:
+                continue
+            scalars = [
+                a for a in list(node.args) + [k.value for k in node.keywords]
+                if isinstance(a, ast.Constant)
+                and type(a.value) in (int, float)
+            ]
+            for a in scalars:
+                out.append(self.finding(
+                    ctx, a,
+                    f"Python scalar {a.value!r} fed to jitted `{bind}` — "
+                    "every distinct value retraces and recompiles; wrap "
+                    "it (jnp.asarray/jnp.int32) or mark the arg static",
+                ))
+        return out
+
+    @staticmethod
+    def _has_kw(call: ast.Call, *names: str) -> bool:
+        return any(k.arg in names for k in call.keywords)
+
+    def _check_jit_call(self, call: ast.Call, ctx) -> List[Finding]:
+        if not call.args:
+            return []
+        fn_name = _dotted(call.args[0])
+        if fn_name is None:
+            return []
+        short = fn_name.rsplit(".", 1)[-1]
+        if _STEP_FN_RE.search(short) and not self._has_kw(
+            call, "donate_argnums", "donate_argnames"
+        ):
+            return [self.finding(
+                ctx, call,
+                f"jax.jit of buffer-carrying `{short}` without "
+                "donate_argnums keeps the old buffer live (2x HBM for "
+                "the state)",
+            )]
+        return []
+
+
+class KernelShapeContract(Rule):
+    id = "LUX003"
+    title = "kernel-shape-contract"
+    doc = ("Pallas BlockSpecs: 128-lane blocks, rows 1 or a multiple of "
+           "8; kernel dtype contract: int8 code planes, int32 row "
+           "indices (ops/merge_tail_plan.py layout rules)")
+
+    _CODE_DTYPES = {"int8", "int32"}   # codes upcast to int32 in-kernel
+    _ROW_DTYPES = {"int32"}
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return "ops/" in ctx.posix_path
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        is_kernel_file = "kernel" in ctx.posix_path.rsplit("/", 1)[-1]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            short = name.rsplit(".", 1)[-1] if name else (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            if short in ("BlockSpec", "ShapeDtypeStruct"):
+                out.extend(self._check_shape(node, short, ctx))
+            elif short == "astype" and is_kernel_file:
+                out.extend(self._check_astype(node, ctx))
+        return out
+
+    def _check_shape(self, node: ast.Call, short: str, ctx) -> List[Finding]:
+        if not node.args or not isinstance(node.args[0], ast.Tuple):
+            return []
+        elts = node.args[0].elts
+        out: List[Finding] = []
+        if not elts:
+            return out
+        last = elts[-1]
+        if isinstance(last, ast.Constant) and isinstance(last.value, int) \
+                and last.value % _LANE != 0:
+            out.append(self.finding(
+                ctx, last,
+                f"{short} lane width {last.value} — the trailing block "
+                f"dim must be a multiple of {_LANE} (VPU lane tile); "
+                "narrower blocks scalarize",
+            ))
+        if short == "BlockSpec" and len(elts) >= 2:
+            first = elts[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, int
+            ) and first.value != 1 and first.value % _SUBLANE != 0:
+                out.append(self.finding(
+                    ctx, first,
+                    f"BlockSpec sublane rows {first.value} — rows must "
+                    f"be 1 (scalar-prefetch per-row form) or a multiple "
+                    f"of {_SUBLANE} (Mosaic 8-row block units)",
+                ))
+        return out
+
+    def _check_astype(self, node: ast.Call, ctx) -> List[Finding]:
+        if len(node.args) != 1 or not isinstance(node.func, ast.Attribute):
+            return []
+        dt = node.args[0]
+        dtype = dt.value if isinstance(dt, ast.Constant) else (
+            (_dotted(dt) or "").rsplit(".", 1)[-1]
+        )
+        if not isinstance(dtype, str) or not dtype:
+            return []
+        ident = (_root_ident(node.func.value) or "").lower()
+        if "code" in ident and dtype not in self._CODE_DTYPES:
+            return [self.finding(
+                ctx, node,
+                f"code plane `{ident}` cast to {dtype} — the routing "
+                "plane contract is int8 at rest (int32 in-kernel)",
+            )]
+        if "row" in ident and dtype not in self._ROW_DTYPES:
+            return [self.finding(
+                ctx, node,
+                f"row-index `{ident}` cast to {dtype} — scalar-prefetch "
+                "row offsets must be int32 on device",
+            )]
+        return []
+
+
+def _env_key(call: ast.Call) -> Optional[str]:
+    """The literal LUX_* key of an os.environ access, if any."""
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str) and \
+            call.args[0].value.startswith("LUX_"):
+        return call.args[0].value
+    return None
+
+
+class EnvFlagRegistry(Rule):
+    id = "LUX004"
+    title = "env-flag-registry"
+    doc = ("every LUX_* env key touched anywhere must be declared in "
+           "lux_tpu/utils/flags.py")
+
+    _ENV_CALLS = ("environ.get", "environ.setdefault", "environ.pop",
+                  "getenv")
+    _FLAG_CALLS = ("get", "get_int", "get_float", "get_bool", "tristate")
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            key = None
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func) or ""
+                short = name.rsplit(".", 1)[-1]
+                if any(name.endswith(c) for c in self._ENV_CALLS):
+                    key = _env_key(node)
+                elif short in self._FLAG_CALLS and (
+                    "flags." in name or name.startswith("flags")
+                ):
+                    key = _env_key(node)
+            elif isinstance(node, ast.Subscript):
+                name = _dotted(node.value) or ""
+                if name.endswith("environ") and isinstance(
+                    node.slice, ast.Constant
+                ) and isinstance(node.slice.value, str) and \
+                        node.slice.value.startswith("LUX_"):
+                    key = node.slice.value
+            if key is not None and key not in ctx.declared_flags:
+                out.append(self.finding(
+                    ctx, node,
+                    f"undeclared flag {key} — declare it in "
+                    "lux_tpu/utils/flags.py so the registry stays the "
+                    "single source of truth",
+                ))
+        return out
+
+
+class DirectEnvRead(Rule):
+    id = "LUX005"
+    title = "direct-env-read"
+    doc = ("lux_tpu code must read LUX_* knobs through "
+           "lux_tpu.utils.flags, not os.environ (writes stay legal)")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return "lux_tpu/" in ctx.posix_path and not ctx.posix_path.endswith(
+            "utils/flags.py"
+        )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            key = None
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func) or ""
+                if name.endswith("environ.get") or name.endswith("getenv"):
+                    key = _env_key(node)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                name = _dotted(node.value) or ""
+                if name.endswith("environ") and isinstance(
+                    node.slice, ast.Constant
+                ) and isinstance(node.slice.value, str) and \
+                        node.slice.value.startswith("LUX_"):
+                    key = node.slice.value
+            if key is not None:
+                out.append(self.finding(
+                    ctx, node,
+                    f"direct os.environ read of {key} — use "
+                    "lux_tpu.utils.flags accessors (typed, documented, "
+                    "registry-checked)",
+                ))
+        return out
+
+
+def all_rules() -> List[Rule]:
+    return [
+        HostSyncInHotLoop(),
+        RecompileHygiene(),
+        KernelShapeContract(),
+        EnvFlagRegistry(),
+        DirectEnvRead(),
+    ]

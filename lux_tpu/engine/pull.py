@@ -13,7 +13,6 @@ JAX async dispatch: `run()` enqueues every step and blocks once at the end.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional
 
 import jax
@@ -29,6 +28,7 @@ from lux_tpu.obs import (
     recorder_for,
 )
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
+from lux_tpu.utils import flags
 from lux_tpu.utils.timing import Timer
 
 
@@ -78,7 +78,10 @@ def run_pipelined(step, vals, num_iters: int, flush_every: int = 8,
     for i in range(num_iters):
         vals = step(vals)
         if flush_every and (i + 1) % flush_every == 0:
-            jax.block_until_ready(vals)
+            # Bounded-depth flush: this sync IS the point of the
+            # pipelined path (caps in-flight dispatch like the
+            # reference's SLIDING_WINDOW).
+            jax.block_until_ready(vals)  # luxlint: disable=LUX001 -- designed flush point, one sync per flush_every iters
             rec.flush(i + 1)
     vals = hard_sync(vals)
     rec.flush(num_iters)
@@ -199,8 +202,9 @@ def _chunk_boundary_plan(row_ptr: np.ndarray, ne: int, chunk: int):
 
 
 # Auto edge-chunking threshold: flat contributions above this many bytes
-# route through the scan path (overridable via LUX_EDGE_CHUNK_BYTES).
-EDGE_CHUNK_AUTO_BYTES = 2 << 30
+# route through the scan path (override via the LUX_EDGE_CHUNK_BYTES
+# flag; the default lives in the utils/flags.py registry).
+EDGE_CHUNK_AUTO_BYTES = flags.default("LUX_EDGE_CHUNK_BYTES")
 DEFAULT_EDGE_CHUNK = 1 << 20
 # Ceiling for the boundary-dense degrade path (growing windows / flat
 # fallback): any single contribution allocation past this is refused in
@@ -320,9 +324,7 @@ class PullExecutor:
         vshape = tuple(getattr(program, "value_shape", ()) or ())
         width = int(np.prod(vshape)) if vshape else 1
         if edge_chunk is None:
-            limit = int(
-                os.environ.get("LUX_EDGE_CHUNK_BYTES", EDGE_CHUNK_AUTO_BYTES)
-            )
+            limit = flags.get_int("LUX_EDGE_CHUNK_BYTES")
             flat_bytes = graph.ne * width * np.dtype(np.float32).itemsize
             self.edge_chunk = (
                 DEFAULT_EDGE_CHUNK
@@ -400,10 +402,11 @@ class PullExecutor:
             span, dst_lo = _dst_slice_plan(
                 graph.col_dst, graph.ne, C, graph.nv
             )
-            knob = os.environ.get("LUX_DST_SLICE", "")
+            knob = flags.tristate("LUX_DST_SLICE", strict=False)
             auto = 0 < span < graph.nv and nchunks * span <= graph.ne // 2
             self._dst_span = span if (
-                (knob == "1" and span < graph.nv) or (knob != "0" and auto)
+                (knob is True and span < graph.nv)
+                or (knob is not False and auto)
             ) else 0
 
             # Source-band gathers (per-chunk lax.cond — see
@@ -412,14 +415,15 @@ class PullExecutor:
             span_s, src_lo, src_banded = _src_slice_plan(
                 graph.col_src, graph.ne, C, graph.nv, row_b
             )
-            sknob = os.environ.get("LUX_SRC_SLICE", "")
+            sknob = flags.tristate("LUX_SRC_SLICE", strict=False)
             # Traffic guard (mirrors the dst path's): each banded chunk
             # pays ~2*span rows of slice copy to save ~C rows of
             # big-table gather at ~5x the sub-cliff rate — only a clear
             # win while the span stays within a couple of chunk sizes.
             s_auto = 0 < span_s <= 2 * C
             self._src_span = span_s if (
-                (sknob == "1" and span_s) or (sknob != "0" and s_auto)
+                (sknob is True and span_s)
+                or (sknob is not False and s_auto)
             ) else 0
 
             def padded(a):

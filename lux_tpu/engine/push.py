@@ -668,6 +668,7 @@ def _run_to_fixpoint(multi, state, max_iters, chunk, recorder=None):
         state, counts, flags, done, last = multi(state, limit, k)
         # One batched transfer: on a tunneled TPU every device_get is a
         # full round-trip (~tens of ms), so fetch everything together.
+        # luxlint: disable=LUX001 -- one batched fetch per chunk (not per iter) is the fixpoint design
         counts_h, flags_h, done_h, last_h = jax.device_get(
             (counts, flags, done, last)
         )

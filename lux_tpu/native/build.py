@@ -20,11 +20,10 @@ _FAILED = False
 
 
 def _cache_dir() -> str:
-    base = os.environ.get(
-        "LUX_NATIVE_CACHE",
-        os.path.join(
-            os.path.expanduser("~"), ".cache", "lux_tpu_native"
-        ),
+    from lux_tpu.utils import flags
+
+    base = flags.get("LUX_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "lux_tpu_native"
     )
     os.makedirs(base, exist_ok=True)
     return base

@@ -20,9 +20,9 @@ edges traversed / iteration time (``gteps()``).
 
 from __future__ import annotations
 
-import os
 import time
 
+from ..utils import flags
 from . import metrics, trace
 
 
@@ -64,7 +64,7 @@ NULL_RECORDER = _NullRecorder()
 
 
 def telemetry_enabled() -> bool:
-    return bool(os.environ.get("LUX_METRICS")) or trace.enabled()
+    return bool(flags.get("LUX_METRICS")) or trace.enabled()
 
 
 def recorder_for(engine: str, graph, program=None):

@@ -11,8 +11,8 @@ coexist; readers take the last line for the headline run.
 from __future__ import annotations
 
 import json
-import os
 
+from ..utils import flags
 from ..utils.logging import get_logger
 from . import metrics
 
@@ -62,7 +62,7 @@ def _format_row(r: dict) -> str:
 def finalize(summary: dict):
     log = get_logger("perf")
     log.info("%s", _format_table(summary))
-    path = os.environ.get("LUX_METRICS")
+    path = flags.get("LUX_METRICS")
     if not path:
         return
     record = dict(summary)

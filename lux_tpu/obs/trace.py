@@ -22,6 +22,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from ..utils import flags
+
 _EPOCH = time.perf_counter()
 
 _lock = threading.Lock()
@@ -50,7 +52,7 @@ def _open_writer(path):
 def reconfigure():
     """Re-read ``LUX_TRACE`` (CLI flags set the env var then call this)."""
     with _lock:
-        path = os.environ.get("LUX_TRACE") or None
+        path = flags.get("LUX_TRACE") or None
         if path != _path or (path and _writer is None):
             _open_writer(path)
 

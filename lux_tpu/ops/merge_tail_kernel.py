@@ -37,7 +37,6 @@ segment reduction.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Tuple
 
 import jax
@@ -46,13 +45,14 @@ import numpy as np
 
 from lux_tpu.ops.merge_tail_plan import GroupedTailPlan
 from lux_tpu.ops.segment import segment_sum_by_rowptr
+from lux_tpu.utils import flags
 
 BLOCK = 128
 
 
 def grouped_tail_enabled() -> bool:
     """Opt-in flag for the grouped (merge-network) tail phase."""
-    return os.environ.get("LUX_GROUPED_TAIL", "") not in ("", "0")
+    return flags.get_bool("LUX_GROUPED_TAIL")
 
 
 @dataclasses.dataclass(eq=False)

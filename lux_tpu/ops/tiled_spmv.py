@@ -311,16 +311,11 @@ def plan_hybrid(
     # of materializing s/d/strip_id at all (LUX_PLAN_BANDED=0/1
     # overrides); later levels run on the (much reduced or at least
     # already-paid-for) tail arrays.
-    import os
+    from lux_tpu.utils import flags
 
-    knob = os.environ.get("LUX_PLAN_BANDED", "")
-    if knob not in ("", "0", "1"):
-        raise ValueError(
-            f"LUX_PLAN_BANDED={knob!r}: use '1' (force banded), "
-            "'0' (force direct), or unset (auto by edge count)"
-        )
-    banded0 = knob == "1" or (
-        knob != "0" and graph.ne >= _PLAN_BANDED_MIN_NE
+    knob = flags.tristate("LUX_PLAN_BANDED")
+    banded0 = knob is True or (
+        knob is None and graph.ne >= _PLAN_BANDED_MIN_NE
     )
     s = d = None
     if not banded0:
@@ -741,9 +736,9 @@ def resolve_pack(pack, plan_cap: int):
     validation) — only the env opt-in degrades silently. Per-level, r
     must be even (checked at the call sites via ``r % 2 == 0``)."""
     if pack is None:
-        import os
+        from lux_tpu.utils import flags
 
-        pack = bool(int(os.environ.get("LUX_PACK_STRIPS", "0")))
+        pack = flags.get_bool("LUX_PACK_STRIPS")
     elif pack and plan_cap > 15:
         raise ValueError(
             f"pack=True needs a plan with count cap <= 15 (got cap="

@@ -5,8 +5,14 @@ XLA compile — per invocation; a served query must not. The pool keys an
 executor by everything that changes its executable: (program name, graph
 fingerprint, engine kind, parts, strategy/batch-width), builds it at most
 once, warms it (compile outside any request), and hands the same object
-to every subsequent query. Hit/miss counters are the smoke test's
-"zero recompiles after warmup" evidence.
+to every subsequent query.
+
+Evidence that the contract holds comes at two levels: hit/miss counters
+(an engine was or wasn't rebuilt) and a
+:class:`~lux_tpu.analysis.sentinel.RecompileSentinel` counting actual
+XLA backend compiles per key — builds run under ``expect(key)``, the
+session executes queries under ``watch(key)``, and any compile landing
+in a watch region is a recompile the stats (and the serve tests) flag.
 """
 
 from __future__ import annotations
@@ -14,17 +20,19 @@ from __future__ import annotations
 import threading
 from typing import Callable, Hashable
 
+from lux_tpu.analysis.sentinel import RecompileSentinel
 from lux_tpu.obs import metrics, trace
 
 
 class EnginePool:
     """Thread-safe keyed singleton store for warmed executors."""
 
-    def __init__(self):
+    def __init__(self, scope: str = "serve"):
         self._engines = {}
         self._lock = threading.Lock()
         self._hits = metrics.counter("lux_serve_pool_hits_total")
         self._misses = metrics.counter("lux_serve_pool_misses_total")
+        self.sentinel = RecompileSentinel(scope)
 
     def get(self, key: Hashable, factory: Callable[[], object]):
         """The executor for ``key``, building (and warming, if the
@@ -41,9 +49,10 @@ class EnginePool:
             self._misses.inc()
             with trace.span("serve.engine_build", cat="serve",
                             key=str(key)):
-                ex = factory()
-                if hasattr(ex, "warmup"):
-                    ex.warmup()
+                with self.sentinel.expect(key):
+                    ex = factory()
+                    if hasattr(ex, "warmup"):
+                        ex.warmup()
             self._engines[key] = ex
             return ex
 
@@ -56,4 +65,9 @@ class EnginePool:
             "engines": len(self),
             "hits": int(self._hits.value),
             "misses": int(self._misses.value),
+            "warmup_compiles": self.sentinel.compiles(),
+            "recompiles": self.sentinel.recompiles(),
         }
+
+    def close(self):
+        self.sentinel.close()

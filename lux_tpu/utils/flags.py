@@ -1,0 +1,206 @@
+"""Central registry for every ``LUX_*`` environment flag.
+
+The knobs grew one module at a time (tiled_spmv, merge_tail_kernel, the
+obs layer, bench.py) until ~20 ``os.environ`` reads were scattered with
+no single place to discover a flag's name, default, or meaning. This
+module is that place: every flag is :func:`define`'d here with a doc
+line, call sites read through the typed accessors, and luxlint's
+env-flag rules (LUX004/LUX005, lux_tpu/analysis/rules.py) enforce both
+"every LUX_* key is declared" and "lux_tpu code reads through flags, not
+os.environ".
+
+Accessors re-read ``os.environ`` on every call — flags stay runtime
+knobs (CLI flags and tests set env vars after first import; cf.
+logging.reconfigure / trace.reconfigure).
+
+``python -m lux_tpu.utils.flags`` prints the flag table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+__all__ = [
+    "Flag", "define", "declared", "names", "default", "get", "get_int",
+    "get_float", "get_bool", "tristate", "table",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str          # LUX_* env var name
+    default: object    # value returned when the env var is unset
+    doc: str           # one line: what the flag does / legal values
+    kind: str = "str"  # str | path | int | float | bool | tristate
+
+
+_REGISTRY: Dict[str, Flag] = {}
+
+
+def define(name: str, default, doc: str, kind: str = "str") -> Flag:
+    """Declare a flag. Redefining with a different spec raises — two
+    modules silently disagreeing on a default is the failure mode a
+    central registry exists to prevent."""
+    if not name.startswith("LUX_"):
+        raise ValueError(f"flag name must start with LUX_: {name!r}")
+    f = Flag(name, default, doc, kind)
+    old = _REGISTRY.get(name)
+    if old is not None and old != f:
+        raise ValueError(f"flag {name} already defined as {old}")
+    _REGISTRY[name] = f
+    return f
+
+
+def declared(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def names() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+def _flag(name: str) -> Flag:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared flag {name!r}: declare it in lux_tpu/utils/flags.py"
+        ) from None
+
+
+def default(name: str):
+    """The declared default (modules alias it so constants can't drift
+    from the registry)."""
+    return _flag(name).default
+
+
+def get(name: str) -> Optional[str]:
+    """Raw string value: the env var if set, else the declared default
+    (coerced to str unless None)."""
+    f = _flag(name)
+    v = os.environ.get(name)
+    if v is not None:
+        return v
+    return f.default if f.default is None else str(f.default)
+
+
+def get_int(name: str) -> int:
+    return int(get(name))
+
+
+def get_float(name: str) -> float:
+    return float(get(name))
+
+
+def get_bool(name: str) -> bool:
+    """Unset → declared default; '' / '0' / 'false' / 'no' / 'off'
+    (case-insensitive) → False; anything else → True."""
+    f = _flag(name)
+    v = os.environ.get(name)
+    if v is None:
+        return bool(f.default)
+    return v.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+def tristate(name: str, strict: bool = True) -> Optional[bool]:
+    """Three-way override knob: unset/'' → None (auto), '0' → False
+    (force off), '1' → True (force on). Other values raise when
+    ``strict`` (the flag gates a planning decision that must not be
+    silently misread), else behave as unset."""
+    _flag(name)
+    v = os.environ.get(name, "")
+    if v == "":
+        return None
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    if strict:
+        raise ValueError(
+            f"{name}={v!r}: use '1' (force on), '0' (force off), or unset "
+            "(auto)"
+        )
+    return None
+
+
+def table() -> str:
+    """Human-readable flag table (name, kind, default, doc)."""
+    rows = [("flag", "kind", "default", "doc")]
+    for name in names():
+        f = _REGISTRY[name]
+        rows.append((f.name, f.kind, repr(f.default), f.doc))
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    w2 = max(len(r[2]) for r in rows)
+    return "\n".join(
+        f"{r[0]:<{w0}}  {r[1]:<{w1}}  {r[2]:<{w2}}  {r[3]}" for r in rows
+    )
+
+
+# -- the flags -------------------------------------------------------------
+# Observability (lux_tpu/obs, utils/logging.py)
+define("LUX_LOG", "INFO",
+       "log level for the lux.* logger categories (DEBUG..CRITICAL)")
+define("LUX_METRICS", None,
+       "append one JSON run-report line (summary + metrics snapshot) per "
+       "run to this path", kind="path")
+define("LUX_TRACE", None,
+       "stream Chrome trace_event JSON-lines to this path", kind="path")
+
+# Backend / native toolchain (utils/platform.py, native/build.py)
+define("LUX_PLATFORM", None,
+       "force the JAX platform (e.g. cpu) before any backend initializes")
+define("LUX_NATIVE_CACHE", None,
+       "native-library build cache dir (default ~/.cache/lux_tpu_native)",
+       kind="path")
+
+# Engine / kernel knobs (engine/pull.py, ops/tiled_spmv.py,
+# ops/merge_tail_kernel.py)
+define("LUX_EDGE_CHUNK_BYTES", 2 << 30,
+       "flat-contribution byte threshold above which the pull engine "
+       "runs edge-chunked", kind="int")
+define("LUX_DST_SLICE", None,
+       "chunked-engine dst-band gather: 1 force, 0 off, unset auto by "
+       "traffic", kind="tristate")
+define("LUX_SRC_SLICE", None,
+       "chunked-engine src-band gather: 1 force, 0 off, unset auto by "
+       "span", kind="tristate")
+define("LUX_PLAN_BANDED", None,
+       "tiled planner level-0 banded passes: 1 force, 0 direct, unset "
+       "auto by edge count", kind="tristate")
+define("LUX_PACK_STRIPS", False,
+       "opt-in nibble packing of even-r strip levels (needs plan count "
+       "cap <= 15)", kind="bool")
+define("LUX_GROUPED_TAIL", False,
+       "opt-in grouped (merge-network) tail phase in the tiled executors",
+       kind="bool")
+
+# bench.py suite knobs
+define("LUX_BENCH_SCALE", 22, "bench.py R-MAT scale", kind="int")
+define("LUX_BENCH_EF", 16, "bench.py R-MAT edge factor", kind="int")
+define("LUX_BENCH_ITERS", 50, "bench.py PageRank iterations", kind="int")
+define("LUX_BENCH_CACHE", None,
+       "bench.py graph cache dir (default <repo>/.bench_cache)",
+       kind="path")
+define("LUX_BENCH_LAYOUT", "tiled", "bench.py engine layout: tiled|flat")
+define("LUX_BENCH_TILE_MB", 8192, "bench.py tiled-plan budget in MB",
+       kind="int")
+define("LUX_BENCH_LEVELS", "8/2",
+       "bench.py tiled plan levels as r/cap[,r/cap...]")
+define("LUX_BENCH_SUITE", True,
+       "bench.py: run the full suite (0 = headline only)", kind="bool")
+define("LUX_BENCH_DEADLINE", 480.0,
+       "bench.py total seconds of bench budget", kind="float")
+
+# Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
+define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")
+define("LUX_SMOKE_ITERS", 8, "obs_smoke PageRank iterations", kind="int")
+define("LUX_SMOKE_QUERIES", 8, "serve_smoke SSSP query count", kind="int")
+define("LUX_SMOKE_EDGES", 1 << 20,
+       "merge_smoke heavy-tail synthetic edge count", kind="int")
+
+
+if __name__ == "__main__":
+    print(table())

@@ -12,8 +12,9 @@ with a ``LUX_LOG`` env var as the runtime knob, re-readable at runtime via
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from . import flags
 
 PERF_CATEGORY = "perf"
 
@@ -22,7 +23,7 @@ _HANDLER = None
 
 
 def _apply_level(root: logging.Logger):
-    level = os.environ.get("LUX_LOG", "INFO").upper()
+    level = (flags.get("LUX_LOG") or "INFO").upper()
     root.setLevel(getattr(logging, level, logging.INFO))
 
 

@@ -40,11 +40,11 @@ def ensure_backend() -> str:
     configured platform cannot initialize. ``LUX_PLATFORM=cpu`` forces a
     platform regardless of what the environment's sitecustomize set up
     (JAX_PLATFORMS can be overridden before we run)."""
-    import os
-
     import jax
 
-    forced = os.environ.get("LUX_PLATFORM")
+    from lux_tpu.utils import flags
+
+    forced = flags.get("LUX_PLATFORM")
     if forced:
         jax.config.update("jax_platforms", forced)
         got = jax.devices()[0].platform

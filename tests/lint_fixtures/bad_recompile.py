@@ -1,0 +1,24 @@
+"""LUX002 fixture: every `# expect:` line must fire recompile-hygiene."""
+import jax
+
+
+def apply(state, rate):
+    return state * rate
+
+
+def make_step(graph):
+    def step(state, graph):
+        return state
+
+    jitted = jax.jit(step)                     # expect: LUX002
+    return jitted
+
+
+@jax.jit                                       # expect: LUX002
+def run_kernel(state):
+    return state
+
+
+def drive(state):
+    stepper = jax.jit(apply, donate_argnums=0)
+    return stepper(state, 0.85)                # expect: LUX002

@@ -1,0 +1,26 @@
+"""LUX001 fixture: every `# expect:` line must fire host-sync-in-hot-loop.
+
+Never imported or executed — parsed by tests/test_analysis.py. The
+`engine/` path component puts it in LUX001's scope.
+"""
+import jax
+import numpy as np
+
+
+def run_loop(step, vals, n):
+    for _ in range(n):
+        vals = step(vals)
+        host = np.asarray(vals)                # expect: LUX001
+        jax.block_until_ready(vals)            # expect: LUX001
+        jax.device_get(vals)                   # expect: LUX001
+        score = float(vals[0])                 # expect: LUX001
+        done = vals.sum().item()               # expect: LUX001
+    return vals, host, score, done
+
+
+def run_fixpoint(multi, state, chunk):
+    total = 0
+    while total < chunk:
+        state, done = multi(state, chunk)
+        total += hard_sync(done)               # expect: LUX001
+    return state

@@ -1,0 +1,30 @@
+"""LUX001 fixture: zero findings expected.
+
+Syncs outside the loop, host-tainted conversions, and sync in
+non-hot-path functions are all legal.
+"""
+import jax
+import numpy as np
+
+
+def run_loop(step, vals, n):
+    for _ in range(n):
+        vals = step(vals)
+    jax.block_until_ready(vals)        # after the loop: legal
+    return vals
+
+
+def run_fixpoint(multi, state, chunk):
+    # One fetch outside any loop; converting the fetched HOST value
+    # inside the loop is free and must not be flagged.
+    done_h = jax.device_get(multi(state, chunk))
+    total = 0
+    for _ in range(chunk):
+        total += int(np.asarray(done_h).reshape(-1)[0])
+    return total
+
+
+def warmup(step, vals):
+    # Not a run/fixpoint/pipelined function: syncs per dispatch by design.
+    for _ in range(2):
+        jax.block_until_ready(step(vals))

@@ -1,0 +1,12 @@
+"""LUX001 fixture: two real violations, both suppressed with a reason —
+the report must show 0 findings and 2 suppressed."""
+import jax
+
+
+def run_flush(step, vals, n):
+    for i in range(n):
+        vals = step(vals)
+        jax.block_until_ready(vals)  # luxlint: disable=LUX001 -- designed flush point
+        # luxlint: disable=all -- comment-only line covers the next line
+        jax.device_get(vals)
+    return vals

@@ -1,0 +1,29 @@
+"""LUX002 fixture: zero findings expected — donated buffers, static
+scalars, and non-step jits are all legal."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def advance(state, k):
+    return state + k
+
+
+def make_step(graph):
+    def step(state, graph):
+        return state
+
+    return jax.jit(step, donate_argnums=0)
+
+
+@partial(jax.jit, donate_argnums=0)
+def run_phase(state):
+    return state
+
+
+def drive(state):
+    stepper = jax.jit(advance, static_argnums=1)
+    out = stepper(state, 16)          # static arg: legal
+    mapped = jax.jit(jnp.sqrt)        # not a buffer-carrying step
+    return mapped(out)

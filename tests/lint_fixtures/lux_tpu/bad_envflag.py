@@ -1,0 +1,9 @@
+"""LUX004/LUX005 fixture. The `lux_tpu/` path component puts it in
+LUX005's scope; LUX004 applies everywhere."""
+import os
+
+from lux_tpu.utils import flags
+
+MODE = os.environ.get("LUX_FAKE_MODE", "")     # expect: LUX004, LUX005
+LEVEL = os.environ["LUX_LOG"]                  # expect: LUX005
+DEPTH = flags.get_int("LUX_NOT_DECLARED")      # expect: LUX004

@@ -1,0 +1,17 @@
+"""LUX003 fixture: every `# expect:` line must fire kernel-shape-contract.
+
+Lives under an `ops/` path component; "kernel" in the basename arms the
+dtype-contract checks.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def make_specs(codes, row_idx):
+    spec = pl.BlockSpec((8, 64), lambda i: (i, 0))        # expect: LUX003
+    spec2 = pl.BlockSpec((5, 128), lambda i: (i, 0))      # expect: LUX003
+    out = jax.ShapeDtypeStruct((16, 100), jnp.float32)    # expect: LUX003
+    codes_w = codes.astype(jnp.int16)                     # expect: LUX003
+    rows = row_idx.astype(jnp.int64)                      # expect: LUX003
+    return spec, spec2, out, codes_w, rows

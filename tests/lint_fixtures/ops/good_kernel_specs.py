@@ -1,0 +1,14 @@
+"""LUX003 fixture: zero findings expected — 128-lane blocks, 8-row (or
+scalar-prefetch single-row) sublanes, contract dtypes."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def make_specs(codes, row_idx, nvb):
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    row_spec = pl.BlockSpec((1, 128), lambda i: (i, 0))   # per-row form
+    out = jax.ShapeDtypeStruct((nvb, 128), jnp.float32)   # symbolic rows
+    codes_w = codes.astype(jnp.int8)
+    rows = row_idx.astype(jnp.int32)
+    return spec, row_spec, out, codes_w, rows

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""luxlint: project-native static analysis over lux_tpu/ + tools/.
+
+Usage:
+    python tools/luxlint.py                  # lint the default tree
+    python tools/luxlint.py path.py dir/     # lint specific targets
+    python tools/luxlint.py --json           # full findings as JSON
+    python tools/luxlint.py --list-rules     # rule table
+    python tools/luxlint.py --select LUX001  # subset of rules
+
+Exit status: 0 clean, 1 unsuppressed findings or syntax errors. Always
+emits one greppable summary line (`LUXLINT {...}`, the merge_smoke
+idiom) so CI logs carry the verdict even when output scrolls.
+
+Suppress a finding inline, with a reason:
+    x.item()  # luxlint: disable=LUX001 -- intended once-per-run sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from lux_tpu.analysis import all_rules, run_paths  # noqa: E402
+
+DEFAULT_TARGETS = ("lux_tpu", "tools", "bench.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="luxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGETS})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON on stdout")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.title}\n       {r.doc}")
+        return 0
+    if args.select:
+        want = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = want - {r.id for r in rules}
+        if unknown:
+            ap.error(f"unknown rule id(s): {sorted(unknown)}")
+        rules = [r for r in rules if r.id in want]
+
+    paths = args.paths or [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
+    report = run_paths(paths, rules)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.format_human())
+    print("LUXLINT " + json.dumps(report.summary(), sort_keys=True))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,7 +56,9 @@ def main() -> int:
     )
     from lux_tpu.ops.merge_tail_ref import BLOCK, schedule_grouped
 
-    target_edges = int(os.environ.get("LUX_SMOKE_EDGES", str(1 << 20)))
+    from lux_tpu.utils import flags
+
+    target_edges = flags.get_int("LUX_SMOKE_EDGES")
     rng = np.random.default_rng(0)
 
     # -- 1. scheduler vs planner on small random skewed run sets --------

@@ -20,15 +20,17 @@ sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    scale = int(os.environ.get("LUX_SMOKE_SCALE", "10"))
-    ni = int(os.environ.get("LUX_SMOKE_ITERS", "8"))
+    from lux_tpu.utils import flags
+
+    scale = flags.get_int("LUX_SMOKE_SCALE")
+    ni = flags.get_int("LUX_SMOKE_ITERS")
 
     # Force CPU before any backend initializes (the environment's
     # sitecustomize may register a TPU plugin).
     os.environ.setdefault("LUX_PLATFORM", "cpu")
     import jax
 
-    jax.config.update("jax_platforms", os.environ["LUX_PLATFORM"])
+    jax.config.update("jax_platforms", flags.get("LUX_PLATFORM"))
 
     from lux_tpu.graph import generate, write_lux
     from lux_tpu.models import pagerank

@@ -133,7 +133,9 @@ def main() -> int:
         os.environ.setdefault("LUX_PLATFORM", "cpu")
         import jax
 
-        jax.config.update("jax_platforms", os.environ["LUX_PLATFORM"])
+        from lux_tpu.utils import flags
+
+        jax.config.update("jax_platforms", flags.get("LUX_PLATFORM"))
         from lux_tpu.graph import generate
         from lux_tpu.serve import ServeConfig, Session
 

@@ -55,13 +55,15 @@ def batch_histogram(base):
 
 
 def main() -> int:
-    scale = int(os.environ.get("LUX_SMOKE_SCALE", "10"))
-    n_sssp = int(os.environ.get("LUX_SMOKE_QUERIES", "8"))
+    from lux_tpu.utils import flags
+
+    scale = flags.get_int("LUX_SMOKE_SCALE")
+    n_sssp = flags.get_int("LUX_SMOKE_QUERIES")
 
     os.environ.setdefault("LUX_PLATFORM", "cpu")
     import jax
 
-    jax.config.update("jax_platforms", os.environ["LUX_PLATFORM"])
+    jax.config.update("jax_platforms", flags.get("LUX_PLATFORM"))
 
     from lux_tpu.engine.push import PushExecutor
     from lux_tpu.graph import generate, write_lux
@@ -148,9 +150,14 @@ def main() -> int:
             f"engines were built during the query phase: "
             f"{misses_before} -> {misses_after}"
         )
+        recompiles = stats["pool"].get("recompiles", 0)
+        assert recompiles == 0, (
+            f"RecompileSentinel saw {recompiles} XLA compile(s) in the "
+            "post-warmup query phase"
+        )
         print(f"warm pool: {stats['pool']['engines']} engines, "
               f"{stats['pool']['hits']} hits, miss count flat at "
-              f"{misses_after} (zero recompiles after warmup)")
+              f"{misses_after}, sentinel recompiles {recompiles}")
         if "latency_s" in stats:
             print(f"latency: p50={stats['latency_s']['p50'] * 1e3:.1f}ms "
                   f"p99={stats['latency_s']['p99'] * 1e3:.1f}ms over "

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint test verify bench obs-smoke serve-smoke serve-bench merge-smoke clean
+.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-bench merge-smoke clean
 
 all: native
 
@@ -12,10 +12,16 @@ native:
 lint:
 	python tools/luxlint.py
 
+lint-ir:
+	python tools/luxlint.py --ir
+
+plan-check:
+	python tools/plan_check.py
+
 test:
 	python -m pytest tests/ -q
 
-verify: lint test
+verify: lint lint-ir plan-check test
 
 bench:
 	python bench.py

@@ -149,6 +149,10 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 class LintReport:
     results: List[FileResult]
     elapsed_s: float
+    # The AST tier reports "luxlint.v1"; the jaxpr tier (analysis/ir.py)
+    # and the plan-artifact tier (analysis/planck.py) stamp their own
+    # schemas so one grep distinguishes which pass produced a line.
+    schema: str = "luxlint.v1"
 
     @property
     def findings(self) -> List[Finding]:
@@ -172,7 +176,7 @@ class LintReport:
         for f in self.findings:
             by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         return {
-            "schema": "luxlint.v1",
+            "schema": self.schema,
             "files": len(self.results),
             "findings": len(self.findings),
             "suppressed": len(self.suppressed),

@@ -1,0 +1,536 @@
+"""luxlint-IR: rules over *traced* programs (jaxprs), not source text.
+
+The AST tier (analysis/rules.py) sees what the code says; this tier sees
+what the traced computation actually does. Every registered program ×
+executor step is traced to a ClosedJaxpr on a tiny synthetic graph —
+abstract eval only, nothing runs on a device — and the equations are
+walked by the LUX1xx rules:
+
+- LUX101 dtype-drift: a carry leaf whose dtype differs between loop
+  input and output reshapes/retraces every iteration; silent promotion
+  to a 64-bit dtype doubles HBM and halves VPU throughput.
+- LUX102 host-callback: ``pure_callback``/``debug_callback``/
+  ``io_callback`` inside a jitted step is a hidden device->host round
+  trip per iteration (the LUX001 failure mode, visible post-trace even
+  when the AST can't see it).
+- LUX103 footprint-blowup: a static per-eqn cost model flags any traced
+  intermediate larger than ``LUX_IR_BLOWUP`` x the step's total input
+  bytes — the O(nnz)-broadcast class of bugs, caught before a 2^31-edge
+  run OOMs.
+- LUX104 donation-audit: args declared in ``donate_argnums`` whose
+  buffers the lowered executable does not actually alias (the donation
+  silently buys nothing and HBM holds two copies).
+- LUX105 collective-audit: collectives in a single-shard trace, or a
+  sharded exchange trace with no collective at all (the ZC-exchange
+  surface wired wrong).
+
+Tracing is cheap (~ms per target) but imports jax — keep this module
+OUT of the AST tier's import path; ``tools/luxlint.py`` loads it only
+under ``--ir``.
+
+Executors participate by exposing ``trace_step(**init_kw)`` returning a
+plain dict (no dependency on this module)::
+
+    {"kind": "pull",            # executor kind, for the target name
+     "fn": self._step,          # the jitted step callable itself
+     "args": (vals, dgraph),    # example args exactly as run() passes
+     "donate": (0,),            # argnums the jit donates
+     "carry": (0,),             # argnums whose leaves are the carry
+     "sharded": False}          # True when collectives are expected
+
+with optional ``call``/``lower`` overrides when the jit takes static
+arguments the example args don't show (MultiSourcePushExecutor). The
+contract relied on by LUX101: the step's flattened outputs begin with
+the new carry, leaf-for-leaf against the flattened carry args.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+import warnings
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from lux_tpu.analysis.core import FileResult, Finding, LintReport
+from lux_tpu.utils import flags
+
+IR_SCHEMA = "luxlint.ir.v1"
+
+# Primitive-name fragments identifying host callbacks (LUX102) and
+# cross-device collectives (LUX105). Matched by name, not identity, so
+# the rule set survives jax moving primitives between modules.
+CALLBACK_PRIMS = ("pure_callback", "debug_callback", "io_callback")
+COLLECTIVE_PRIMS = (
+    "psum", "pmax", "pmin", "ppermute", "pgather", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter",
+)
+
+
+@dataclasses.dataclass
+class TraceTarget:
+    """One traceable step: a callable + example args + audit metadata."""
+
+    name: str                       # e.g. "pagerank@pull"
+    call: Callable                  # callable(*args) -> step outputs
+    args: Tuple = ()                # example args (dynamic only)
+    donate: Tuple[int, ...] = ()    # argnums donated by the real jit
+    carry: Tuple[int, ...] = (0,)   # argnums forming the iteration carry
+    sharded: bool = False           # collectives expected iff True
+    lower: Optional[Callable] = None  # () -> jax.stages.Lowered
+    axis_env: Tuple = ()            # [(name, size)] for axis-using fns
+
+
+def target_from_spec(name: str, spec: dict) -> TraceTarget:
+    """Normalize an executor's (or fixture's) trace dict to a target."""
+    fn = spec.get("fn")
+    call = spec.get("call", fn)
+    if call is None:
+        raise ValueError(f"trace spec {name!r} has neither 'call' nor 'fn'")
+    args = tuple(spec.get("args", ()))
+    lower = spec.get("lower")
+    if lower is None and hasattr(fn, "lower"):
+        lower = lambda fn=fn, args=args: fn.lower(*args)  # noqa: E731
+    return TraceTarget(
+        name=name, call=call, args=args,
+        donate=tuple(spec.get("donate", ())),
+        carry=tuple(spec.get("carry", (0,))),
+        sharded=bool(spec.get("sharded", False)),
+        lower=lower,
+        axis_env=tuple(spec.get("axis_env", ())),
+    )
+
+
+def trace_target(target: TraceTarget):
+    """Abstract-eval the target to a ClosedJaxpr (no device work)."""
+    import jax
+
+    if target.axis_env:
+        mk = jax.make_jaxpr(target.call, axis_env=list(target.axis_env))
+    else:
+        mk = jax.make_jaxpr(target.call)
+    return mk(*target.args)
+
+
+# -- jaxpr walking ------------------------------------------------------
+
+def _as_jaxprs(v) -> List:
+    from jax import core as jcore
+
+    if isinstance(v, jcore.ClosedJaxpr):
+        return [v.jaxpr]
+    if isinstance(v, jcore.Jaxpr):
+        return [v]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_as_jaxprs(x))
+        return out
+    return []
+
+
+def iter_eqns(jaxpr) -> Iterable:
+    """Depth-first walk over every eqn, descending into sub-jaxprs
+    (pjit/scan/while/cond/shard_map/custom_* all carry theirs in
+    params; matching by type keeps the walk version-proof)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _as_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def _carry_leaf_indices(target: TraceTarget) -> List[int]:
+    """Flat in_aval indices of the carry args (args flatten in order)."""
+    import jax
+
+    out: List[int] = []
+    pos = 0
+    for i, a in enumerate(target.args):
+        n = len(jax.tree_util.tree_leaves(a))
+        if i in target.carry:
+            out.extend(range(pos, pos + n))
+        pos += n
+    return out
+
+
+# -- the rules ----------------------------------------------------------
+
+class IRRule:
+    """One IR rule: an id, a one-line doc, a check over a ClosedJaxpr."""
+
+    id = "LUX100"
+    title = "base ir rule"
+    doc = ""
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, target: TraceTarget, line: int, message: str) -> Finding:
+        # `line` is the 1-based eqn ordinal in the depth-first walk
+        # (0 = a target-level finding with no single eqn to blame).
+        return Finding(self.id, target.name, line, 0, message)
+
+
+class DtypeDrift(IRRule):
+    id = "LUX101"
+    title = "dtype-drift"
+    doc = ("carry dtype must be identical between loop input and output; "
+           "no silent promotion to 64-bit dtypes inside the step")
+
+    @staticmethod
+    def _wide(dtype) -> bool:
+        dt = np.dtype(dtype)
+        return dt.kind in "fiuc" and dt.itemsize >= 8
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        carry_idx = _carry_leaf_indices(target)
+        in_avals, out_avals = closed.in_avals, closed.out_avals
+        if len(carry_idx) > len(out_avals):
+            yield self.finding(
+                target, 0,
+                f"carry has {len(carry_idx)} leaves but the step returns "
+                f"only {len(out_avals)} outputs — the carry cannot round-"
+                "trip through this step",
+            )
+            return
+        for j, idx in enumerate(carry_idx):
+            din = getattr(in_avals[idx], "dtype", None)
+            dout = getattr(out_avals[j], "dtype", None)
+            if din is not None and dout is not None and din != dout:
+                yield self.finding(
+                    target, 0,
+                    f"carry leaf {j} enters as {din} and leaves as {dout} "
+                    "— every iteration converts (or retraces) the carry",
+                )
+        if any(self._wide(a.dtype) for a in in_avals
+               if getattr(a, "dtype", None) is not None):
+            return   # 64-bit inputs make 64-bit intermediates legitimate
+        for k, eqn in enumerate(iter_eqns(closed.jaxpr), start=1):
+            for ov in eqn.outvars:
+                dt = getattr(ov.aval, "dtype", None)
+                if dt is not None and self._wide(dt):
+                    yield self.finding(
+                        target, k,
+                        f"`{eqn.primitive.name}` silently promotes to "
+                        f"{np.dtype(dt).name} with no 64-bit input — "
+                        "x64 drift doubles HBM for the affected values",
+                    )
+
+
+class HostCallback(IRRule):
+    id = "LUX102"
+    title = "host-callback"
+    doc = ("no pure_callback/debug_callback/io_callback inside a jitted "
+           "hot-path step (hidden host round trip per iteration)")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        for k, eqn in enumerate(iter_eqns(closed.jaxpr), start=1):
+            name = eqn.primitive.name
+            if name in CALLBACK_PRIMS or name.endswith("callback"):
+                yield self.finding(
+                    target, k,
+                    f"host callback `{name}` in the jitted step — every "
+                    "iteration stalls on a device->host->device round "
+                    "trip",
+                )
+
+
+class FootprintBlowup(IRRule):
+    id = "LUX103"
+    title = "footprint-blowup"
+    doc = ("no traced intermediate may exceed LUX_IR_BLOWUP x the "
+           "step's total input bytes (static per-eqn cost model)")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        ratio = flags.get_float("LUX_IR_BLOWUP")
+        base = sum(_aval_bytes(a) for a in closed.in_avals)
+        base += sum(int(getattr(c, "nbytes", 0)) for c in closed.consts)
+        limit = ratio * max(base, 1)
+        for k, eqn in enumerate(iter_eqns(closed.jaxpr), start=1):
+            for ov in eqn.outvars:
+                nbytes = _aval_bytes(ov.aval)
+                if nbytes > limit:
+                    aval = ov.aval
+                    yield self.finding(
+                        target, k,
+                        f"`{eqn.primitive.name}` materializes "
+                        f"{tuple(aval.shape)} {np.dtype(aval.dtype).name} "
+                        f"({nbytes / 2**20:.1f} MiB) = "
+                        f"{nbytes / max(base, 1):.0f}x the step inputs "
+                        f"(limit {ratio:g}x, LUX_IR_BLOWUP)",
+                    )
+
+
+def _main_arg_attrs(mlir_text: str) -> Optional[str]:
+    """The argument list of the entry function in lowered StableHLO
+    text (between ``@main(`` and its closing paren), or None."""
+    m = re.search(r"func\.func (?:public )?@main\(", mlir_text)
+    if m is None:
+        return None
+    start = m.end()
+    depth = 1
+    for i in range(start, len(mlir_text)):
+        ch = mlir_text[i]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return mlir_text[start:i]
+    return None
+
+
+class DonationAudit(IRRule):
+    id = "LUX104"
+    title = "donation-audit"
+    doc = ("every donate_argnums buffer must actually be aliased to an "
+           "output by the lowered executable (else the donation buys "
+           "nothing and HBM holds two copies)")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        import jax
+
+        if not target.donate or target.lower is None:
+            return
+        donated = []
+        for i in target.donate:
+            if i < len(target.args):
+                donated.extend(jax.tree_util.tree_leaves(target.args[i]))
+        expected = len(donated)
+        if expected == 0:
+            return
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            lowered = target.lower()
+        sig = _main_arg_attrs(lowered.as_text())
+        if sig is None:
+            yield self.finding(
+                target, 0,
+                "could not locate @main in the lowered module — donation "
+                "audit impossible for this target",
+            )
+            return
+        # Single-shard lowerings resolve aliasing right away
+        # (`tf.aliasing_output = N`); sharded lowerings defer the pairing
+        # to the compiler and only mark `jax.buffer_donor = true`.
+        aliased = sig.count("tf.aliasing_output")
+        deferred = sig.count("jax.buffer_donor")
+        if aliased + deferred < expected:
+            notes = "; ".join(
+                str(w.message) for w in caught
+                if "donat" in str(w.message).lower()
+            )
+            detail = f" ({notes})" if notes else ""
+            yield self.finding(
+                target, 0,
+                f"{expected - (aliased + deferred)} of {expected} donated "
+                "buffers are not aliased to any output — the executable "
+                f"copies instead of reusing them{detail}",
+            )
+            return
+        if deferred:
+            # The compiler will alias a deferred donor only if some
+            # output matches its shape+dtype — check that statically.
+            if closed is not None:
+                out_leaves = [
+                    a for a in closed.out_avals if hasattr(a, "shape")
+                ]
+            else:
+                out_tree = jax.eval_shape(target.call, *target.args)
+                out_leaves = jax.tree_util.tree_leaves(out_tree)
+            pool = [
+                (tuple(a.shape), np.dtype(a.dtype)) for a in out_leaves
+            ]
+            unmatched = []
+            for leaf in donated:
+                key = (tuple(leaf.shape), np.dtype(leaf.dtype))
+                if key in pool:
+                    pool.remove(key)
+                else:
+                    unmatched.append(key)
+            for shape, dtype in unmatched:
+                yield self.finding(
+                    target, 0,
+                    f"donated buffer {shape} {dtype.name} has no shape/"
+                    "dtype-matching output to alias — the donation buys "
+                    "nothing",
+                )
+
+
+class CollectiveAudit(IRRule):
+    id = "LUX105"
+    title = "collective-audit"
+    doc = ("collectives (psum/all_gather/...) must not appear in single-"
+           "shard traces and must appear in sharded exchange traces")
+
+    @staticmethod
+    def _is_collective(name: str) -> bool:
+        return any(
+            name == c or name.startswith(c + "_") for c in COLLECTIVE_PRIMS
+        )
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        seen: List[Tuple[int, str]] = []
+        for k, eqn in enumerate(iter_eqns(closed.jaxpr), start=1):
+            if self._is_collective(eqn.primitive.name):
+                seen.append((k, eqn.primitive.name))
+        if target.sharded and not seen:
+            yield self.finding(
+                target, 0,
+                "sharded exchange trace contains no collective — shards "
+                "never communicate, so every shard computes on stale "
+                "neighbor values",
+            )
+        if not target.sharded:
+            for k, name in seen:
+                yield self.finding(
+                    target, k,
+                    f"collective `{name}` in a single-shard trace — "
+                    "either dead cross-device traffic or a program "
+                    "traced with the wrong executor",
+                )
+
+
+def all_ir_rules() -> List[IRRule]:
+    return [
+        DtypeDrift(),
+        HostCallback(),
+        FootprintBlowup(),
+        DonationAudit(),
+        CollectiveAudit(),
+    ]
+
+
+# -- runner -------------------------------------------------------------
+
+def run_targets(targets: Sequence[TraceTarget],
+                rules: Optional[Sequence[IRRule]] = None) -> LintReport:
+    """Trace every target and run the IR rules over the jaxprs."""
+    t0 = time.perf_counter()
+    if rules is None:
+        rules = all_ir_rules()
+    results: List[FileResult] = []
+    for t in targets:
+        try:
+            closed = trace_target(t)
+        except Exception as e:   # traced user code: anything can raise
+            results.append(FileResult(
+                t.name, [], [], error=f"{t.name}: trace failed: {e!r}"))
+            continue
+        findings: List[Finding] = []
+        errors: List[str] = []
+        for rule in rules:
+            try:
+                findings.extend(rule.check(closed, t))
+            except Exception as e:
+                errors.append(f"{t.name}: {rule.id} crashed: {e!r}")
+        findings.sort(key=lambda f: (f.line, f.rule))
+        results.append(FileResult(
+            t.name, findings, [], error="; ".join(errors) or None))
+    return LintReport(results, time.perf_counter() - t0, schema=IR_SCHEMA)
+
+
+# -- the registry trace matrix ------------------------------------------
+
+def _tiny_graph(weighted: bool, seed: int):
+    """Small synthetic graph: big enough to exercise every code path's
+    shapes, small enough that building executors stays milliseconds."""
+    from lux_tpu.graph.generate import gnp
+
+    return gnp(96, 400, seed=seed, weighted=weighted)
+
+
+def build_executor(kind: str, graph, program):
+    """One executor of the given kind over (graph, program) — the same
+    constructions cli.py / serve use, defaults throughout."""
+    if kind == "pull":
+        from lux_tpu.engine.pull import PullExecutor
+        return PullExecutor(graph, program)
+    if kind == "tiled":
+        from lux_tpu.engine.tiled import TiledPullExecutor
+        return TiledPullExecutor(graph, program)
+    if kind == "push":
+        from lux_tpu.engine.push import PushExecutor
+        return PushExecutor(graph, program)
+    if kind == "push_multi":
+        from lux_tpu.engine.push import MultiSourcePushExecutor
+        return MultiSourcePushExecutor(graph, program, k=4)
+    if kind == "pull_sharded":
+        from lux_tpu.engine.pull_sharded import ShardedPullExecutor
+        return ShardedPullExecutor(graph, program)
+    if kind == "tiled_sharded":
+        from lux_tpu.engine.tiled_sharded import ShardedTiledExecutor
+        return ShardedTiledExecutor(graph, program)
+    if kind == "push_sharded":
+        from lux_tpu.engine.push import ShardedPushExecutor
+        return ShardedPushExecutor(graph, program)
+    raise ValueError(f"unknown executor kind {kind!r}")
+
+
+def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
+    """Trace targets for every registered program x capable executor."""
+    from lux_tpu.models import PROGRAMS, ROOTED_APPS, engine_kinds
+
+    targets: List[TraceTarget] = []
+    for i, name in enumerate(sorted(PROGRAMS)):
+        program = PROGRAMS[name]()
+        weighted = bool(getattr(program, "needs_weights", False))
+        graph = _tiny_graph(weighted=weighted, seed=7 + i)
+        init_kw = {"start": 0} if name in ROOTED_APPS else {}
+        for kind in engine_kinds(name):
+            if not include_sharded and kind.endswith("sharded"):
+                continue
+            ex = build_executor(kind, graph, program)
+            spec = ex.trace_step(**init_kw)
+            targets.append(target_from_spec(f"{name}@{kind}", spec))
+    return targets
+
+
+def load_fixture_targets(path: str) -> List[TraceTarget]:
+    """Targets from a fixture module exposing ``TRACES`` (a list of
+    trace dicts with a ``name`` key) — the seeded-violation harness."""
+    import importlib.util
+    import os
+
+    modname = "_luxlint_ir_fixture_" + \
+        os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(modname, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load fixture module {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    traces = getattr(mod, "TRACES", None)
+    if not traces:
+        raise ValueError(f"fixture {path} exposes no TRACES")
+    return [
+        target_from_spec(t.get("name", f"{path}#{i}"), t)
+        for i, t in enumerate(traces)
+    ]
+
+
+def audit_engine(engine, name: str, **init_kw) -> List[Finding]:
+    """Build-time donation audit of one executor (serve/pool.py hook):
+    LUX104 only — one abstract lowering, no trace walk, no execution.
+    Engines without ``trace_step`` are silently fine."""
+    ts = getattr(engine, "trace_step", None)
+    if ts is None:
+        return []
+    target = target_from_spec(name, ts(**init_kw))
+    rule = DonationAudit()
+    try:
+        # check() needs no jaxpr for LUX104; pass None explicitly.
+        return list(rule.check(None, target))
+    except Exception as e:
+        return [Finding(rule.id, name, 0, 0,
+                        f"donation audit crashed: {e!r}")]

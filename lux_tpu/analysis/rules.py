@@ -370,14 +370,40 @@ class EnvFlagRegistry(Rule):
     id = "LUX004"
     title = "env-flag-registry"
     doc = ("every LUX_* env key touched anywhere must be declared in "
-           "lux_tpu/utils/flags.py")
+           "lux_tpu/utils/flags.py; flags.define() outside that file is "
+           "registry drift")
 
     _ENV_CALLS = ("environ.get", "environ.setdefault", "environ.pop",
                   "getenv")
     _FLAG_CALLS = ("get", "get_int", "get_float", "get_bool", "tristate")
 
+    @staticmethod
+    def _define_aliases(tree: ast.Module) -> Set[str]:
+        """Local names bound to lux_tpu.utils.flags.define by imports."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.endswith("utils.flags"):
+                out.update(
+                    a.asname or a.name for a in node.names
+                    if a.name == "define"
+                )
+        return out
+
     def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
         out: List[Finding] = []
+        in_registry = ctx.posix_path.endswith("utils/flags.py")
+        define_aliases = self._define_aliases(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and not in_registry:
+                name = _dotted(node.func) or ""
+                if name.endswith("flags.define") or name in define_aliases:
+                    out.append(self.finding(
+                        ctx, node,
+                        "flags.define() outside lux_tpu/utils/flags.py — "
+                        "the registry is the single declaration site; "
+                        "LUX004's allowed-key set is generated from it",
+                    ))
         for node in ast.walk(tree):
             key = None
             if isinstance(node, ast.Call):

@@ -610,6 +610,22 @@ class PullExecutor:
             hard_sync(self.step(self.init_values()))
         note_compile_seconds(self, t.elapsed)
 
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted step plus example
+        args exactly as step() passes them — lane-padded for K-vector
+        programs, so the audit sees the executable's real signature."""
+        vals = self.init_values()
+        if self._kpad:
+            vals = self._lane_pad(jnp.asarray(vals))
+        return {
+            "kind": "pull",
+            "fn": self._step,
+            "args": (vals, self.dgraph),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
+
     def run(
         self,
         num_iters: int,

@@ -260,6 +260,19 @@ class ShardedPullExecutor:
             hard_sync(self.step(self.init_values()))
         note_compile_seconds(self, t.elapsed)
 
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
+        sharded=True, so LUX105 demands the exchange all-gather shows
+        up in the trace."""
+        return {
+            "kind": "pull_sharded",
+            "fn": self._step,
+            "args": (self.init_values(), self._device_graph),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": True,
+        }
+
     def _exchange_bytes_per_iter(self) -> int:
         """ICI bytes moved by one iteration's all-gather: each of the P
         shards sends its (max_nv, kreal-or-scalar) slice to the P-1

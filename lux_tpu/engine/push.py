@@ -655,6 +655,18 @@ class PushExecutor:
             _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
         note_compile_seconds(self, t.elapsed)
 
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted single-iteration
+        step with example args exactly as step() passes them."""
+        return {
+            "kind": "push",
+            "fn": self._step,
+            "args": (self.init_state(**init_kw), self._dg),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
+
 
 def _run_to_fixpoint(multi, state, max_iters, chunk, recorder=None):
     rec = recorder if recorder is not None else NULL_RECORDER
@@ -816,6 +828,24 @@ class MultiSourcePushExecutor:
                 self._multi, self.init_state([start]), 1, chunk
             )
         note_compile_seconds(self, t.elapsed)
+
+    def trace_step(self, start: int = 0, **init_kw):
+        """luxlint-IR hook (analysis/ir.py). The chunk executable takes
+        a static width k and a dynamic iteration limit the example args
+        can't carry, so `call`/`lower` close over them explicitly."""
+        state = self.init_state([start])
+        fn, dg, k = self._multi_jit, self._dg, self.k
+        lim = jnp.int32(1)
+        return {
+            "kind": "push_multi",
+            "fn": fn,
+            "args": (state, dg),
+            "call": lambda st, d: fn(st, d, k, limit=lim),
+            "lower": lambda: fn.lower(state, dg, k, limit=lim),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
 
     def values_for(self, state: PushState, j: int) -> np.ndarray:
         """Host copy of lane ``j``'s value column."""
@@ -1387,6 +1417,18 @@ class ShardedPushExecutor:
         with Timer() as t:
             _run_to_fixpoint(self._multi, self.init_state(**init_kw), 1, chunk)
         note_compile_seconds(self, t.elapsed)
+
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
+        sharded=True, so LUX105 demands a collective in the trace."""
+        return {
+            "kind": "push_sharded",
+            "fn": self._step,
+            "args": (self.init_state(**init_kw), self._dg),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": True,
+        }
 
     def gather_values(self, state: PushState) -> np.ndarray:
         return self.sg.from_padded(np.asarray(jax.device_get(state.values)))

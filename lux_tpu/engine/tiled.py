@@ -368,6 +368,19 @@ class TiledPullExecutor:
                                recorder=NULL_RECORDER))
         note_compile_seconds(self, t.elapsed)
 
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted step with its
+        real argument tuple (device data travels as jit ARGS here, see
+        _step_args above — the audit must see that same signature)."""
+        return {
+            "kind": "tiled",
+            "fn": self._jstep,
+            "args": (self._init_internal(), *self._step_args),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
+
     def run(
         self,
         num_iters: int,

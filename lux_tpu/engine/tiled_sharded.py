@@ -279,6 +279,7 @@ class ShardedTiledExecutor:
             check_vma=False,
         )
         jstep = jax.jit(mapped, donate_argnums=0)
+        self._jstep = jstep   # bare jit, for trace_step / luxlint-IR
         self._step = lambda vals: jstep(vals, self._shard_args, self._replicated)
         self._jrun = make_fused_runner(mapped)
 
@@ -593,6 +594,20 @@ class ShardedTiledExecutor:
         with Timer() as t:
             hard_sync(self.step(self.init_values()))
         note_compile_seconds(self, t.elapsed)
+
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted shard_map step
+        with its real argument tuple; sharded=True, so LUX105 demands
+        the strip psum / exchange all-gather in the trace."""
+        return {
+            "kind": "tiled_sharded",
+            "fn": self._jstep,
+            "args": (self.init_values(), self._shard_args,
+                     self._replicated),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": True,
+        }
 
     def _exchange_bytes_per_iter(self, vals) -> int:
         """ICI bytes for one iteration's all-gather of the (P, max_nv)

@@ -20,6 +20,27 @@ PROGRAMS = {
 
 ROOTED_APPS = frozenset({"sssp"})
 
+# Which executor kinds can run each program (the luxlint-IR trace
+# matrix, analysis/ir.py — and the capability map cli/serve consult).
+# tiled is spmv-only (sum combiner, identity contrib, scalar values);
+# push needs a PushProgram; multi-source batching needs a rooted app.
+ENGINE_KINDS = {
+    "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded"),
+    "sssp": ("push", "push_multi", "push_sharded"),
+    "components": ("push", "push_sharded"),
+    "colfilter": ("pull", "pull_sharded"),
+}
+
+
+def engine_kinds(name: str):
+    """Executor kinds capable of running the program named ``name``."""
+    try:
+        return ENGINE_KINDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown app {name!r}; registered: {sorted(ENGINE_KINDS)}"
+        ) from None
+
 
 def get_program(name: str):
     """Instantiate the vertex program registered under ``name``."""
@@ -38,5 +59,7 @@ __all__ = [
     "CollaborativeFiltering",
     "PROGRAMS",
     "ROOTED_APPS",
+    "ENGINE_KINDS",
+    "engine_kinds",
     "get_program",
 ]

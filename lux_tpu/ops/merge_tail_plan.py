@@ -454,6 +454,12 @@ _PLAN_ARRAYS = (
 )
 _FORMAT = 1
 
+# Public artifact-format contract: analysis/planck.py carries a jax-free
+# mirror of these so `luxlint --plans` never imports this package;
+# test_ir.py asserts the mirror and this source of truth stay identical.
+PLAN_ARRAYS = _PLAN_ARRAYS
+PLAN_FORMAT = _FORMAT
+
 
 def save_grouped_plan(path: str, plan: GroupedTailPlan) -> None:
     """Write the plan as a directory of raw .npy files + meta.json,

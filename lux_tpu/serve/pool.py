@@ -22,6 +22,7 @@ from typing import Callable, Hashable
 
 from lux_tpu.analysis.sentinel import RecompileSentinel
 from lux_tpu.obs import metrics, trace
+from lux_tpu.utils import flags
 
 
 class EnginePool:
@@ -32,6 +33,9 @@ class EnginePool:
         self._lock = threading.Lock()
         self._hits = metrics.counter("lux_serve_pool_hits_total")
         self._misses = metrics.counter("lux_serve_pool_misses_total")
+        # Created eagerly so a clean pool still exports 0 — the serve
+        # dashboards alert on this going nonzero, not on its absence.
+        self._ir_findings = metrics.counter("lux_ir_findings_total")
         self.sentinel = RecompileSentinel(scope)
 
     def get(self, key: Hashable, factory: Callable[[], object]):
@@ -53,8 +57,27 @@ class EnginePool:
                     ex = factory()
                     if hasattr(ex, "warmup"):
                         ex.warmup()
+            self._audit(key, ex)
             self._engines[key] = ex
             return ex
+
+    def _audit(self, key: Hashable, ex) -> None:
+        """LUX104 donation audit on the freshly built engine: one abstract
+        lowering, no execution. A finding means an iteration buffer the
+        engine thinks it reuses is actually copied every step — flagged
+        once at build time (``lux_ir_findings_total``), never per query."""
+        if not flags.get_bool("LUX_IR_POOL_AUDIT"):
+            return
+        if not hasattr(ex, "trace_step"):
+            return
+        from lux_tpu.analysis import ir
+        try:
+            findings = ir.audit_engine(ex, f"pool@{key}")
+        except Exception:  # audit must never take down a build
+            return
+        for f in findings:
+            self._ir_findings.inc()
+            print(f"EnginePool: {f.format()}")
 
     def __len__(self) -> int:
         with self._lock:
@@ -67,6 +90,7 @@ class EnginePool:
             "misses": int(self._misses.value),
             "warmup_compiles": self.sentinel.compiles(),
             "recompiles": self.sentinel.recompiles(),
+            "ir_findings": int(self._ir_findings.value),
         }
 
     def close(self):

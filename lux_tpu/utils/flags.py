@@ -194,6 +194,19 @@ define("LUX_BENCH_SUITE", True,
 define("LUX_BENCH_DEADLINE", 480.0,
        "bench.py total seconds of bench budget", kind="float")
 
+# Static analysis, IR tier (analysis/ir.py, analysis/planck.py,
+# serve/pool.py)
+define("LUX_IR_BLOWUP", 16.0,
+       "luxlint-IR LUX103: flag any traced intermediate larger than this "
+       "multiple of the step's total input bytes", kind="float")
+define("LUX_IR_POOL_AUDIT", True,
+       "run the LUX104 donation audit on every engine the serve pool "
+       "builds (one abstract lowering per build; 0 disables)", kind="bool")
+define("LUX_PLANCK_INFLATION", 8.0,
+       "luxlint-IR LUX205: max per-level grouped-tail stream inflation "
+       "(rows per level / ceil(reals/128)) a saved plan may carry",
+       kind="float")
+
 # Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
 define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")
 define("LUX_SMOKE_ITERS", 8, "obs_smoke PageRank iterations", kind="int")

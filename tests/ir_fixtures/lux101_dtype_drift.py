@@ -1,0 +1,21 @@
+"""Seeded LUX101 violation: the iteration carry enters as float32 and
+leaves as bfloat16 — every iteration converts (or retraces) the carry.
+
+Loaded by ``tools/luxlint.py --ir <this file>``; the CLI must exit 1.
+"""
+
+import jax.numpy as jnp
+
+
+def _step(vals, deg):
+    # expect: LUX101
+    return (vals / deg).astype(jnp.bfloat16)
+
+
+TRACES = [{
+    "name": "fixture@lux101",
+    "call": _step,
+    "args": (jnp.zeros(64, jnp.float32), jnp.ones(64, jnp.float32)),
+    "carry": (0,),
+    "sharded": False,
+}]

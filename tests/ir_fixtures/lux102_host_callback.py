@@ -1,0 +1,30 @@
+"""Seeded LUX102 violation: a ``pure_callback`` inside the step — a
+hidden device->host->device round trip per iteration.
+
+Loaded by ``tools/luxlint.py --ir <this file>``; the CLI must exit 1.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _host_degree(vals):
+    return np.asarray(vals) * 2
+
+
+def _step(vals):
+    # expect: LUX102
+    doubled = jax.pure_callback(
+        _host_degree, jax.ShapeDtypeStruct(vals.shape, vals.dtype), vals
+    )
+    return doubled + 1.0
+
+
+TRACES = [{
+    "name": "fixture@lux102",
+    "call": _step,
+    "args": (jnp.zeros(64, jnp.float32),),
+    "carry": (0,),
+    "sharded": False,
+}]

@@ -1,0 +1,23 @@
+"""Seeded LUX103 violation: an (n,) x (n,) outer product materializes
+an (n, n) intermediate — n times the step's inputs, the O(nnz)
+broadcast class of bugs.
+
+Loaded by ``tools/luxlint.py --ir <this file>``; the CLI must exit 1.
+"""
+
+import jax.numpy as jnp
+
+
+def _step(vals):
+    # expect: LUX103
+    pairwise = jnp.outer(vals, vals)     # (512, 512) from two (512,)
+    return pairwise.sum(axis=1)
+
+
+TRACES = [{
+    "name": "fixture@lux103",
+    "call": _step,
+    "args": (jnp.zeros(512, jnp.float32),),
+    "carry": (0,),
+    "sharded": False,
+}]

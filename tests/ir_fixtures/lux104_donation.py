@@ -1,0 +1,26 @@
+"""Seeded LUX104 violation: the arg is declared in ``donate_argnums``
+but the step only returns a scalar reduction — no output can alias the
+donated buffer, so the donation buys nothing.
+
+Loaded by ``tools/luxlint.py --ir <this file>``; the CLI must exit 1.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(vals):
+    return vals.sum()
+
+
+# expect: LUX104
+_jstep = jax.jit(_step, donate_argnums=0)
+
+TRACES = [{
+    "name": "fixture@lux104",
+    "fn": _jstep,
+    "args": (jnp.zeros(64, jnp.float32),),
+    "donate": (0,),
+    "carry": (),
+    "sharded": False,
+}]

@@ -1,0 +1,38 @@
+"""Seeded LUX105 violations, both directions: a ``psum`` in a trace
+declared single-shard (dead cross-device traffic), and a trace declared
+sharded that never communicates (stale neighbor values forever).
+
+Loaded by ``tools/luxlint.py --ir <this file>``; the CLI must exit 1.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_with_psum(vals):
+    # expect: LUX105 (collective in a single-shard trace)
+    return jax.lax.psum(vals, "parts")
+
+
+def _step_without_exchange(vals):
+    # expect: LUX105 (sharded trace with no collective)
+    return vals * 0.85 + 0.15
+
+
+TRACES = [
+    {
+        "name": "fixture@lux105-single-shard-psum",
+        "call": _step_with_psum,
+        "args": (jnp.zeros(64, jnp.float32),),
+        "carry": (0,),
+        "sharded": False,
+        "axis_env": (("parts", 4),),
+    },
+    {
+        "name": "fixture@lux105-sharded-no-collective",
+        "call": _step_without_exchange,
+        "args": (jnp.zeros(64, jnp.float32),),
+        "carry": (0,),
+        "sharded": True,
+    },
+]

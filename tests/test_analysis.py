@@ -349,3 +349,113 @@ def test_host_transfer_guard_around_engine_loop():
         with guard.allow():
             jax.block_until_ready(vals)
     assert vals.shape[0] == g.nv
+
+
+def test_flags_define_outside_registry_is_lux004():
+    # Satellite of the registry-drift contract: LUX004's allowed-key set
+    # is generated from utils/flags.py, so a define() anywhere else is
+    # registry drift by construction — including via an import alias.
+    direct = (
+        "from lux_tpu.utils import flags\n"
+        "flags.define('LUX_ROGUE', 1, 'drift', kind='int')\n"
+    )
+    aliased = (
+        "from lux_tpu.utils.flags import define\n"
+        "define('LUX_ROGUE', 1, 'drift', kind='int')\n"
+    )
+    for src in (direct, aliased):
+        res = run_source(
+            src, "lux_tpu/engine/rogue.py", all_rules(),
+            load_declared_flags())
+        assert any(
+            f.rule == "LUX004" and "declaration site" in f.message
+            for f in res.findings
+        ), (src, res.findings)
+    # The registry itself is the one legitimate declaration site.
+    res = run_source(
+        direct, "lux_tpu/utils/flags.py", all_rules(),
+        load_declared_flags())
+    assert not any(
+        "declaration site" in f.message for f in res.findings)
+
+
+def test_ir_flags_are_registered():
+    # The IR tier's knobs went through the registry (LUX004 would flag
+    # their use otherwise).
+    assert flags.get_float("LUX_IR_BLOWUP") == 16.0
+    assert flags.get_bool("LUX_IR_POOL_AUDIT") is True
+    assert flags.get_float("LUX_PLANCK_INFLATION") == 8.0
+
+
+def test_recompile_sentinel_thread_safe_under_concurrent_warmups():
+    # EnginePool serializes builds per pool, but nothing stops several
+    # pools (or a pool and test traffic) compiling at once: concurrent
+    # expect() regions on distinct threads must not lose counts, and
+    # attribution must stay per-thread (TLS region stack).
+    import threading
+
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.sentinel import RecompileSentinel
+
+    sent = RecompileSentinel("race")
+    if not sent.available:
+        sent.close()
+        pytest.skip("jax monitoring hook unavailable in this jax")
+    n = 8
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def warm(i):
+        try:
+            barrier.wait()
+            with sent.expect(f"k{i}"):
+                # Distinct shape per thread: each warmup really compiles.
+                jax.jit(lambda x: x * 2 + i)(
+                    jnp.arange(8 + i)).block_until_ready()
+        except Exception as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=warm, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        per_key = sent.stats()["per_key"]
+        assert set(per_key) == {f"k{i}" for i in range(n)}
+        # No lost updates: the total equals the per-key sum, with at
+        # least one real compile attributed to every thread's region.
+        total = sent.compiles()
+        assert total == sum(v.get("warmup", 0) for v in per_key.values())
+        assert all(v.get("warmup", 0) >= 1 for v in per_key.values())
+        assert sent.recompiles() == 0
+    finally:
+        sent.close()
+
+
+def test_host_transfer_guard_allow_is_reentrant():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from lux_tpu.analysis.sentinel import HostTransferError, HostTransferGuard
+
+    x = jnp.arange(8)
+    with HostTransferGuard("nested") as g:
+        with g.allow():
+            with g.allow():           # nested window: still open
+                assert int(jax.device_get(x)[1]) == 1
+            # Inner exit must not close the outer window.
+            assert int(jax.device_get(x)[2]) == 2
+        with pytest.raises(HostTransferError):
+            jax.device_get(x)
+        # An exception inside a window must not leak the allow depth.
+        with pytest.raises(RuntimeError):
+            with g.allow():
+                raise RuntimeError("boom")
+        with pytest.raises(HostTransferError):
+            jax.device_get(x)
+    assert int(jax.device_get(x)[0]) == 0

@@ -1,0 +1,409 @@
+"""luxlint-IR: the jaxpr-tier rules (LUX101-105), the registry trace
+matrix, the grouped-plan artifact verifier (LUX201-205), the serve-pool
+donation-audit hook, and the CLI tiers (--ir / --plans / --changed /
+--baseline).
+
+Seeded-violation convention (tests/ir_fixtures/): each ``lux1NN_*.py``
+module exposes ``TRACES`` and must make ``luxlint --ir`` exit 1 with
+exactly its own rule firing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lux_tpu.analysis import ir, planck  # noqa: E402
+from lux_tpu.models import ENGINE_KINDS  # noqa: E402
+from lux_tpu.ops import merge_tail_plan as mtp  # noqa: E402
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+LUXLINT = os.path.join(REPO, "tools", "luxlint.py")
+IR_FIXTURES = os.path.join(TESTS, "ir_fixtures")
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, LUXLINT, *argv],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def _summary_line(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("LUXLINT ")]
+    assert lines, stdout
+    return json.loads(lines[-1][len("LUXLINT "):])
+
+
+def _rules(report):
+    return sorted({f.rule for r in report.results for f in r.findings})
+
+
+def _spec_target(call=None, **spec):
+    if call is not None:
+        spec.setdefault("call", call)
+    spec.setdefault("args", (jnp.zeros(64, jnp.float32),))
+    return ir.target_from_spec(spec.pop("name", "unit@test"), spec)
+
+
+# -- IR rule units ------------------------------------------------------
+
+
+def test_registry_matrix_is_clean_and_complete():
+    # The acceptance gate `make lint-ir` runs: every registered program x
+    # capable executor traces, and the shipped tree carries no findings.
+    targets = ir.registry_targets()
+    want = {f"{p}@{k}" for p, kinds in ENGINE_KINDS.items() for k in kinds}
+    assert {t.name for t in targets} == want
+    report = ir.run_targets(targets)
+    assert report.ok, report.format_human()
+    assert report.summary()["schema"] == "luxlint.ir.v1"
+
+
+def test_dtype_drift_on_carry():
+    t = _spec_target(lambda v: (v * 2).astype(jnp.bfloat16))
+    report = ir.run_targets([t], [ir.DtypeDrift()])
+    assert _rules(report) == ["LUX101"]
+    assert "bfloat16" in report.results[0].findings[0].message
+
+
+def test_dtype_drift_carry_cannot_roundtrip():
+    # More carry leaves than step outputs: the carry cannot survive the
+    # step at all — one target-level finding, not a crash.
+    t = _spec_target(
+        lambda a, b: a + b,
+        args=(jnp.zeros(8), jnp.zeros(8)), carry=(0, 1),
+    )
+    report = ir.run_targets([t], [ir.DtypeDrift()])
+    assert _rules(report) == ["LUX101"]
+    assert "round" in report.results[0].findings[0].message
+
+
+def test_host_callback_detected_through_jit_nesting():
+    def step(v):
+        return jax.jit(lambda x: jax.pure_callback(
+            lambda y: np.asarray(y) * 2,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x))(v)
+
+    report = ir.run_targets([_spec_target(step)], [ir.HostCallback()])
+    assert _rules(report) == ["LUX102"]
+
+
+def test_footprint_blowup_respects_flag(monkeypatch):
+    t = _spec_target(lambda v: jnp.outer(v, v).sum(axis=1),
+                     args=(jnp.zeros(512, jnp.float32),))
+    report = ir.run_targets([t], [ir.FootprintBlowup()])
+    assert _rules(report) == ["LUX103"]
+    monkeypatch.setenv("LUX_IR_BLOWUP", "100000")
+    report = ir.run_targets([t], [ir.FootprintBlowup()])
+    assert report.ok
+
+
+def test_donation_audit_passes_aliased_flags_unusable():
+    good = jax.jit(lambda v: v * 2, donate_argnums=0)
+    bad = jax.jit(lambda v: v.sum(), donate_argnums=0)
+    x = (jnp.zeros(64, jnp.float32),)
+    rep = ir.run_targets(
+        [_spec_target(fn=good, args=x, donate=(0,)),
+         _spec_target(fn=bad, args=x, donate=(0,), carry=(), name="u@bad")],
+        [ir.DonationAudit()],
+    )
+    assert not rep.results[0].findings
+    assert [f.rule for f in rep.results[1].findings] == ["LUX104"]
+
+
+def test_collective_audit_both_directions():
+    psum = _spec_target(lambda v: jax.lax.psum(v, "p"),
+                        axis_env=(("p", 4),))
+    silent = _spec_target(lambda v: v * 0.5, sharded=True)
+    rep = ir.run_targets([psum, silent], [ir.CollectiveAudit()])
+    assert [f.rule for r in rep.results for f in r.findings] == \
+        ["LUX105", "LUX105"]
+
+
+def test_trace_failure_is_error_not_crash():
+    def boom(v):
+        raise RuntimeError("fixture trace bomb")
+
+    report = ir.run_targets([_spec_target(boom)])
+    assert not report.ok
+    assert "trace failed" in report.results[0].error
+
+
+@pytest.mark.parametrize("fixture,rule", [
+    ("lux101_dtype_drift.py", "LUX101"),
+    ("lux102_host_callback.py", "LUX102"),
+    ("lux103_blowup.py", "LUX103"),
+    ("lux104_donation.py", "LUX104"),
+    ("lux105_collective.py", "LUX105"),
+])
+def test_seeded_fixture_fires_exactly_its_rule(fixture, rule):
+    targets = ir.load_fixture_targets(os.path.join(IR_FIXTURES, fixture))
+    report = ir.run_targets(targets)
+    assert not report.ok
+    assert _rules(report) == [rule]
+    assert report.summary()["errors"] == 0
+
+
+# -- grouped-plan verifier (planck) -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_plan():
+    rng = np.random.default_rng(3)
+    sizes = np.minimum(
+        rng.lognormal(5.0, 1.2, size=48).astype(np.int64) + 1, 4000)
+    m = int(sizes.sum())
+    sb = np.repeat(np.arange(sizes.size), sizes)
+    rng.shuffle(sb)
+    lane = rng.integers(0, 128, size=m)
+    dst = np.sort(rng.integers(0, 64, size=m))
+    row_ptr = np.searchsorted(dst, np.arange(65))
+    return mtp.plan_grouped_tail(sb, lane, row_ptr)
+
+
+def _mutable(plan, **over):
+    """A SimpleNamespace copy of the plan with writable arrays."""
+    import types
+
+    d = {n: np.array(getattr(plan, n)) for n in planck.PLAN_ARRAYS}
+    d.update(n_edges=plan.n_edges, n_levels=plan.n_levels)
+    d.update(over)
+    return types.SimpleNamespace(**d)
+
+
+def test_planner_output_verifies_clean(small_plan):
+    res = planck.verify_plan(small_plan)
+    assert not res.findings and res.error is None
+
+
+def test_plan_contract_parity_with_ops():
+    # planck duplicates the artifact contract to stay jax-free; this is
+    # the drift tripwire the duplication comment promises.
+    assert planck.PLAN_ARRAYS == mtp.PLAN_ARRAYS
+    assert planck.PLAN_FORMAT == mtp.PLAN_FORMAT
+
+
+def test_plan_loader_roundtrip(tmp_path, small_plan):
+    path = str(tmp_path / "plan")
+    mtp.save_grouped_plan(path, small_plan)
+    loaded = planck.load_plan_artifact(path)
+    assert loaded.n_edges == small_plan.n_edges
+    assert loaded.n_levels == small_plan.n_levels
+    for name in planck.PLAN_ARRAYS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(loaded, name)),
+            np.asarray(getattr(small_plan, name)), err_msg=name)
+    assert not planck.verify_plan(loaded, path).findings
+
+
+def test_plan_structure_rejects_nonmonotone_level_ptr(small_plan):
+    lp = np.array(small_plan.level_ptr)
+    lp[2] = lp[1] - 1
+    res = planck.verify_plan(_mutable(small_plan, level_ptr=lp))
+    assert "LUX201" in {f.rule for f in res.findings}
+
+
+def test_plan_conservation_rejects_extra_real(small_plan):
+    nv = np.array(small_plan.nvalid)
+    idx = int(np.argmax(nv < planck.BLOCK))
+    assert nv[idx] < planck.BLOCK
+    nv[idx] += 1
+    res = planck.verify_plan(_mutable(small_plan, nvalid=nv))
+    assert {f.rule for f in res.findings} == {"LUX202"}
+
+
+def test_plan_code_plane_rejects_pad_garbage(small_plan):
+    codes = np.array(small_plan.codes)
+    nv = np.asarray(small_plan.nvalid)
+    idx = int(np.argmax(nv < planck.BLOCK))
+    codes[idx, -1] = 3
+    res = planck.verify_plan(_mutable(small_plan, codes=codes))
+    assert {f.rule for f in res.findings} == {"LUX203"}
+
+
+def test_plan_code_plane_rejects_wrong_side_lane(small_plan):
+    codes = np.array(small_plan.codes)
+    nv = np.asarray(small_plan.nvalid)
+    r0 = int(small_plan.level_ptr[1])
+    idx = int(np.argmax(nv[:r0] > 0))   # a live level-0 (copy-A) row
+    codes[idx, 0] = -5
+    res = planck.verify_plan(_mutable(small_plan, codes=codes))
+    assert {f.rule for f in res.findings} == {"LUX203"}
+
+
+def test_plan_code_plane_rejects_unknown_mode(small_plan):
+    mode = np.array(small_plan.mode)
+    mode[0] = 7
+    res = planck.verify_plan(_mutable(small_plan, mode=mode))
+    assert "LUX203" in {f.rule for f in res.findings}
+
+
+def test_plan_alignment_rejects_shifted_boundary(small_plan):
+    lp = np.array(small_plan.level_ptr)
+    lp[1] += 1   # still monotone: every level holds >= 8 rows
+    res = planck.verify_plan(_mutable(small_plan, level_ptr=lp))
+    assert "LUX204" in {f.rule for f in res.findings}
+
+
+def test_plan_copy_rate_bound_is_flag_tunable(small_plan, monkeypatch):
+    monkeypatch.setenv("LUX_PLANCK_INFLATION", "0.01")
+    res = planck.verify_plan(small_plan)
+    assert "LUX205" in {f.rule for f in res.findings}
+
+
+def test_unloadable_plan_dir_is_error(tmp_path):
+    report = planck.verify_plan_dirs([str(tmp_path / "nope")])
+    assert not report.ok
+    assert "unloadable" in report.results[0].error
+
+
+# -- serve-pool donation audit ------------------------------------------
+
+
+class _BadDonationEngine:
+    def trace_step(self):
+        fn = jax.jit(lambda v: v.sum(), donate_argnums=0)
+        return {"kind": "bad", "fn": fn,
+                "args": (jnp.zeros(64, jnp.float32),),
+                "donate": (0,), "carry": (), "sharded": False}
+
+
+def test_pool_build_runs_donation_audit(recwarn):
+    from lux_tpu.obs import metrics
+    from lux_tpu.serve.pool import EnginePool
+
+    before = metrics.counter("lux_ir_findings_total").value
+    pool = EnginePool(scope="t_ir_audit")
+    try:
+        pool.get("bad", _BadDonationEngine)
+        assert metrics.counter("lux_ir_findings_total").value == before + 1
+        assert pool.stats()["ir_findings"] >= 1
+    finally:
+        pool.close()
+
+
+def test_pool_audit_disabled_by_flag(monkeypatch, recwarn):
+    from lux_tpu.obs import metrics
+    from lux_tpu.serve.pool import EnginePool
+
+    monkeypatch.setenv("LUX_IR_POOL_AUDIT", "0")
+    before = metrics.counter("lux_ir_findings_total").value
+    pool = EnginePool(scope="t_ir_audit_off")
+    try:
+        pool.get("bad", _BadDonationEngine)
+        assert metrics.counter("lux_ir_findings_total").value == before
+    finally:
+        pool.close()
+
+
+def test_pool_concurrent_first_requests_build_once():
+    from lux_tpu.serve.pool import EnginePool
+
+    pool = EnginePool(scope="t_ir_race")
+    builds = []
+    barrier = threading.Barrier(8)
+
+    def factory():
+        builds.append(1)
+        return object()
+
+    def worker(out, i):
+        barrier.wait()
+        out[i] = pool.get("k", factory)
+
+    try:
+        got = [None] * 8
+        threads = [threading.Thread(target=worker, args=(got, i))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1
+        assert len({id(g) for g in got}) == 1
+        assert len(pool) == 1
+    finally:
+        pool.close()
+
+
+# -- CLI tiers ----------------------------------------------------------
+
+
+def test_cli_ir_matrix_is_green():
+    proc = _run_cli("--ir")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    s = _summary_line(proc.stdout)
+    assert s["schema"] == "luxlint.ir.v1"
+    assert s["files"] == sum(len(k) for k in ENGINE_KINDS.values())
+    assert s["findings"] == 0 and s["errors"] == 0
+
+
+def test_cli_ir_fixture_exits_nonzero():
+    proc = _run_cli("--ir", os.path.join(IR_FIXTURES,
+                                         "lux104_donation.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert _summary_line(proc.stdout)["by_rule"] == {"LUX104": 1}
+
+
+def test_cli_plans_accepts_good_rejects_corrupt(tmp_path, small_plan):
+    good = str(tmp_path / "plan")
+    mtp.save_grouped_plan(good, small_plan)
+    proc = _run_cli("--plans", good)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert _summary_line(proc.stdout)["schema"] == "luxlint.plan.v1"
+
+    lp = np.load(os.path.join(good, "level_ptr.npy"))
+    lp[2] = lp[1] - 1
+    np.save(os.path.join(good, "level_ptr.npy"), lp)
+    proc = _run_cli("--plans", good)
+    assert proc.returncode == 1
+    assert "LUX201" in proc.stdout
+
+
+def test_cli_baseline_masks_known_findings(tmp_path):
+    bad = tmp_path / "engine" / "run_bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "def run(step, vals, n):\n"
+        "    for _ in range(n):\n"
+        "        vals = step(vals)\n"
+        "        done = vals.item()\n"
+        "    return vals, done\n"
+    )
+    base = str(tmp_path / "baseline.json")
+    # First run snapshots the pre-existing finding and passes.
+    proc = _run_cli(str(tmp_path / "engine"), "--baseline", base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baseline written" in proc.stdout
+    # Unchanged tree: the known finding stays masked.
+    proc = _run_cli(str(tmp_path / "engine"), "--baseline", base)
+    assert proc.returncode == 0
+    assert "0 new" in proc.stdout
+    # A fresh violation is NOT masked.
+    worse = tmp_path / "engine" / "run_worse.py"
+    worse.write_text(
+        "def run(step, vals, n):\n"
+        "    for _ in range(n):\n"
+        "        x = float(vals.sum())\n"
+        "    return x\n"
+    )
+    proc = _run_cli(str(tmp_path / "engine"), "--baseline", base)
+    assert proc.returncode == 1
+    assert "[new]" in proc.stdout
+
+
+def test_cli_changed_emits_summary():
+    # Content depends on git state; the contract is: it runs, restricts
+    # to changed files, and still emits the greppable summary line.
+    proc = _run_cli("--changed")
+    assert proc.returncode in (0, 1), proc.stdout + proc.stderr
+    assert _summary_line(proc.stdout)["schema"] == "luxlint.v1"

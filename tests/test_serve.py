@@ -203,7 +203,8 @@ def test_engine_pool_builds_once():
     assert a is bb and builds == [1]
     st = pool.stats()
     assert st == {"engines": 1, "hits": 1, "misses": 1,
-                  "warmup_compiles": 0, "recompiles": 0}
+                  "warmup_compiles": 0, "recompiles": 0,
+                  "ir_findings": 0}
     pool.close()
 
 

@@ -2,17 +2,25 @@
 """luxlint: project-native static analysis over lux_tpu/ + tools/.
 
 Usage:
-    python tools/luxlint.py                  # lint the default tree
+    python tools/luxlint.py                  # lint the default tree (AST tier)
     python tools/luxlint.py path.py dir/     # lint specific targets
+    python tools/luxlint.py --changed        # only files changed vs git HEAD
     python tools/luxlint.py --json           # full findings as JSON
     python tools/luxlint.py --list-rules     # rule table
     python tools/luxlint.py --select LUX001  # subset of rules
+    python tools/luxlint.py --ir             # IR tier: trace every registered
+                                             #   program x executor, run LUX1xx
+    python tools/luxlint.py --ir fixture.py  # trace a module's TRACES list
+    python tools/luxlint.py --plans DIR...   # verify saved GroupedTailPlan
+                                             #   artifacts (LUX2xx, jax-free)
+    python tools/luxlint.py --baseline F     # snapshot/compare: only findings
+                                             #   absent from F fail the run
 
-Exit status: 0 clean, 1 unsuppressed findings or syntax errors. Always
-emits one greppable summary line (`LUXLINT {...}`, the merge_smoke
+Exit status: 0 clean, 1 unsuppressed findings or syntax/trace errors.
+Always emits one greppable summary line (`LUXLINT {...}`, the merge_smoke
 idiom) so CI logs carry the verdict even when output scrolls.
 
-Suppress a finding inline, with a reason:
+Suppress an AST-tier finding inline, with a reason:
     x.item()  # luxlint: disable=LUX001 -- intended once-per-run sync
 """
 
@@ -21,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,38 +40,169 @@ from lux_tpu.analysis import all_rules, run_paths  # noqa: E402
 DEFAULT_TARGETS = ("lux_tpu", "tools", "bench.py")
 
 
+def _changed_paths() -> list:
+    """Python files changed vs HEAD (staged + unstaged + untracked)."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            text = subprocess.run(cmd, cwd=_REPO, capture_output=True,
+                                  text=True, check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"luxlint: --changed: {' '.join(cmd)} failed: {e}",
+                  file=sys.stderr)
+            return []
+        for line in text.splitlines():
+            if line.endswith(".py"):
+                p = os.path.join(_REPO, line)
+                if os.path.isfile(p):
+                    out.add(p)
+    return sorted(out)
+
+
+def _baseline_key(path: str, f) -> str:
+    return f"{f.rule}\t{path}\t{f.message}"
+
+
+def _apply_baseline(report, baseline_path: str) -> int:
+    """Snapshot-or-compare. Missing file: write current findings, pass.
+    Present: fail only on findings whose (rule, path, message) key is new.
+    Line numbers are deliberately not part of the key — unrelated edits
+    shift them."""
+    current = {}
+    for res in report.results:
+        for f in res.findings:
+            current.setdefault(_baseline_key(res.path, f), []).append((res, f))
+    if not os.path.exists(baseline_path):
+        with open(baseline_path, "w") as fh:
+            json.dump({"schema": report.schema + ".baseline",
+                       "keys": sorted(current)}, fh, indent=0)
+        print(f"luxlint: baseline written: {baseline_path} "
+              f"({len(current)} finding keys)")
+        return 0
+    with open(baseline_path) as fh:
+        known = set(json.load(fh).get("keys", ()))
+    new = sorted(k for k in current if k not in known)
+    errors = [r for r in report.results if r.error]
+    for k in new:
+        res, f = current[k][0]
+        print(f"{res.path}:{f.line}:{f.col}: {f.rule} {f.message}  [new]")
+    print(f"luxlint: baseline {baseline_path}: {len(new)} new / "
+          f"{len(current)} total findings, {len(errors)} errors")
+    return 1 if new or errors else 0
+
+
+def _run_ir(paths, select: str):
+    """IR tier: trace registered programs (or fixture modules) and run the
+    LUX1xx jaxpr rules. Mirrors tests/conftest.py's env: 8 virtual CPU
+    devices, CPU platform — set BEFORE jax initializes a backend, so the
+    sharded executors have devices to shard over."""
+    from lux_tpu.utils.platform import virtual_cpu_flags
+    os.environ.setdefault("XLA_FLAGS", virtual_cpu_flags(8))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lux_tpu.analysis import ir
+
+    if paths:
+        targets = []
+        for p in paths:
+            targets.extend(ir.load_fixture_targets(p))
+    else:
+        targets = ir.registry_targets()
+    rules = ir.all_ir_rules()
+    if select:
+        want = {s.strip() for s in select.split(",") if s.strip()}
+        rules = [r for r in rules if r.id in want]
+    return ir.run_targets(targets, rules)
+
+
+def _run_plans(paths, select: str):
+    from lux_tpu.analysis import planck
+    rules = planck.all_plan_rules()
+    if select:
+        want = {s.strip() for s in select.split(",") if s.strip()}
+        rules = [r for r in rules if r.id in want]
+    return planck.verify_plan_dirs(paths, rules)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="luxlint", description=__doc__)
     ap.add_argument("paths", nargs="*",
-                    help=f"files/dirs to lint (default: {DEFAULT_TARGETS})")
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGETS}); "
+                         "with --ir: fixture modules exporting TRACES; "
+                         "with --plans: saved plan artifact dirs")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON on stdout")
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the jaxpr tier (LUX101-105) over every "
+                         "registered program x executor, or over the TRACES "
+                         "of the given fixture modules")
+    ap.add_argument("--plans", action="store_true",
+                    help="verify saved GroupedTailPlan artifact dirs "
+                         "(LUX201-205; jax-free, mmap load)")
+    ap.add_argument("--changed", action="store_true",
+                    help="AST tier only: restrict to .py files changed vs "
+                         "git HEAD (plus untracked)")
+    ap.add_argument("--baseline", default="",
+                    help="snapshot file: if missing, write current findings "
+                         "and pass; if present, fail only on new findings")
     args = ap.parse_args(argv)
 
-    rules = all_rules()
-    if args.list_rules:
-        for r in rules:
-            print(f"{r.id}  {r.title}\n       {r.doc}")
-        return 0
-    if args.select:
-        want = {s.strip() for s in args.select.split(",") if s.strip()}
-        unknown = want - {r.id for r in rules}
-        if unknown:
-            ap.error(f"unknown rule id(s): {sorted(unknown)}")
-        rules = [r for r in rules if r.id in want]
+    if args.ir and args.plans:
+        ap.error("--ir and --plans are separate tiers; run them separately")
 
-    paths = args.paths or [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
-    report = run_paths(paths, rules)
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}  {r.title}\n       {r.doc}")
+        # The IR/plan tiers import numpy/jax; keep --list-rules instant by
+        # documenting them from their modules only when importable cheaply.
+        try:
+            from lux_tpu.analysis import planck
+            for r in planck.all_plan_rules():
+                print(f"{r.id}  {r.title}\n       {r.doc}")
+        except Exception:
+            pass
+        print("LUX101-105  jaxpr tier (dtype drift, host callbacks, "
+              "footprint, donation, collectives) — run with --ir")
+        return 0
+
+    if args.ir:
+        report = _run_ir(args.paths, args.select)
+    elif args.plans:
+        if not args.paths:
+            ap.error("--plans requires at least one artifact directory")
+        report = _run_plans(args.paths, args.select)
+    else:
+        rules = all_rules()
+        if args.select:
+            want = {s.strip() for s in args.select.split(",") if s.strip()}
+            unknown = want - {r.id for r in rules}
+            if unknown:
+                ap.error(f"unknown rule id(s): {sorted(unknown)}")
+            rules = [r for r in rules if r.id in want]
+        if args.changed:
+            paths = _changed_paths()
+            if not paths:
+                print("luxlint: --changed: no modified .py files")
+                print("LUXLINT " + json.dumps(
+                    {"schema": "luxlint.v1", "files": 0, "findings": 0,
+                     "errors": 0, "ok": True}, sort_keys=True))
+                return 0
+        else:
+            paths = args.paths or [os.path.join(_REPO, t)
+                                   for t in DEFAULT_TARGETS]
+        report = run_paths(paths, rules)
 
     if args.json:
         print(report.to_json())
     else:
         print(report.format_human())
     print("LUXLINT " + json.dumps(report.summary(), sort_keys=True))
+    if args.baseline:
+        return _apply_baseline(report, args.baseline)
     return 0 if report.ok else 1
 
 

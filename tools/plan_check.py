@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""plan_check: end-to-end proof the grouped-plan verifier earns its keep.
+
+Synthesizes a heavy-tail run set at serving scale (>= 1M reals by
+default), plans it with ``plan_grouped_tail``, saves the artifact, and
+then demonstrates both halves of the LUX2xx contract:
+
+  1. the shipped planner's output verifies clean, and fast — the wall
+     budget below is asserted, because a verifier too slow to sit in a
+     load path is a verifier nobody runs;
+  2. a byte-corrupted copy of the same artifact is rejected.
+
+Exit status: 0 when both hold. Emits one greppable ``PLANCHECK {...}``
+summary line (the merge_smoke idiom).
+
+Usage:
+    python tools/plan_check.py                # default: ~1.2M reals
+    python tools/plan_check.py --reals 200000 --budget-s 2.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+from lux_tpu.analysis import planck  # noqa: E402
+from lux_tpu.ops import merge_tail_plan as mtp  # noqa: E402
+
+
+def synth_tail(reals: int, seed: int = 0):
+    """Heavy-tail run set in the merge_smoke shape: lognormal run sizes
+    (clipped at the PR-3 smoke ceiling), shuffled interleave, uniform
+    lanes, sorted destination rows."""
+    rng = np.random.default_rng(seed)
+    sizes = np.empty(0, np.int64)
+    while int(sizes.sum()) < reals:
+        more = np.minimum(
+            rng.lognormal(6.4, 1.35, size=256).astype(np.int64) + 1, 79237)
+        sizes = np.concatenate([sizes, more])
+    m = int(sizes.sum())
+    sb = np.repeat(np.arange(sizes.size), sizes)
+    rng.shuffle(sb)
+    lane = rng.integers(0, 128, size=m)
+    nv = max(m // 300, 64)
+    dst = np.sort(rng.integers(0, nv, size=m))
+    row_ptr = np.searchsorted(dst, np.arange(nv + 1))
+    return sb, lane, row_ptr, m
+
+
+def corrupt(src: str, dst: str) -> None:
+    """A plausible on-disk corruption: a stale partial rewrite that bumps
+    one level boundary and inflates one row's lane count — breaks
+    conservation (LUX202) and the code-plane contract (LUX203) without
+    touching array shapes, so only a semantic verifier catches it."""
+    shutil.copytree(src, dst)
+    lp = np.load(os.path.join(dst, "level_ptr.npy"))
+    lp[1] += 8
+    np.save(os.path.join(dst, "level_ptr.npy"), lp)
+    nv = np.load(os.path.join(dst, "nvalid.npy"))
+    nv[0] = 200
+    np.save(os.path.join(dst, "nvalid.npy"), nv)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="plan_check", description=__doc__)
+    ap.add_argument("--reals", type=int, default=1_000_000,
+                    help="minimum reals in the synthetic tail")
+    ap.add_argument("--budget-s", type=float, default=2.0,
+                    help="wall budget for verifying the saved artifact")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep", default="",
+                    help="keep artifacts under this dir instead of a tmpdir")
+    args = ap.parse_args(argv)
+
+    sb, lane, row_ptr, m = synth_tail(args.reals, args.seed)
+    t0 = time.perf_counter()
+    plan = mtp.plan_grouped_tail(sb, lane, row_ptr)
+    plan_s = time.perf_counter() - t0
+
+    root = args.keep or tempfile.mkdtemp(prefix="lux_plan_check_")
+    good = os.path.join(root, "plan")
+    bad = os.path.join(root, "plan_corrupt")
+    mtp.save_grouped_plan(good, plan)
+    corrupt(good, bad)
+
+    t0 = time.perf_counter()
+    rep_good = planck.verify_plan_dirs([good])
+    verify_s = time.perf_counter() - t0
+    rep_bad = planck.verify_plan_dirs([bad])
+
+    for res in rep_good.results:
+        for f in res.findings:
+            print(f.format())
+        if res.error:
+            print(f"{res.path}: {res.error}")
+
+    clean = rep_good.ok
+    fast = verify_s <= args.budget_s
+    caught = not rep_bad.ok
+    ok = clean and fast and caught
+    summary = {
+        "reals": m,
+        "levels": int(plan.n_levels),
+        "rows": int(plan.level_ptr[-1]),
+        "plan_s": round(plan_s, 3),
+        "verify_s": round(verify_s, 3),
+        "budget_s": args.budget_s,
+        "clean": clean,
+        "fast": fast,
+        "corrupt_rules": sorted({f.rule for f in rep_bad.findings}),
+        "corrupt_caught": caught,
+        "ok": ok,
+    }
+    print("PLANCHECK " + json.dumps(summary, sort_keys=True))
+    if not args.keep:
+        shutil.rmtree(root, ignore_errors=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

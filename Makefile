@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-bench merge-smoke clean
+.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke clean
 
 all: native
 
@@ -21,7 +21,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir plan-check test
+verify: lint lint-ir plan-check test serve-obs
 
 bench:
 	python bench.py
@@ -32,11 +32,25 @@ obs-smoke:
 serve-smoke:
 	python tools/serve_smoke.py
 
+# serve-smoke including the observability acceptance: one trace-id
+# across admission->batch->engine->cache, Prometheus /metrics, /statusz,
+# and a flight.v1 postmortem on an injected deadline miss.
+serve-obs:
+	python tools/serve_smoke.py
+
 merge-smoke:
 	python tools/merge_smoke.py
 
 serve-bench:
 	python tools/serve_bench.py --scale 12 --workers 16 --duration 10
+
+# SLO gate: bench -> serve_bench.v1 JSON -> compare against the pinned
+# baseline (written on first run; commit bench/serve_slo_baseline.json).
+serve-slo:
+	python tools/serve_bench.py --scale 10 --workers 8 --duration 5 \
+		--json-out /tmp/lux_serve_bench.json
+	python tools/slo_check.py --input /tmp/lux_serve_bench.json \
+		--baseline bench/serve_slo_baseline.json
 
 clean:
 	rm -rf build ~/.cache/lux_tpu_native

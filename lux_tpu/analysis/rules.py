@@ -20,6 +20,10 @@ code previously only promised in prose:
 - LUX005 direct-env-read: lux_tpu code reads LUX_* knobs through the
   flags module, not os.environ (writes — CLI flag plumbing,
   subprocess setup — stay legal).
+- LUX006 clock-discipline: serve/engine code stamps time through
+  obs.spans helpers (clock() for durations on the trace epoch,
+  monotonic() for deadlines), never raw time.* — mixed clock sources
+  corrupt SLO math and trace alignment.
 
 All pure ``ast``; no jax, no numpy.
 """
@@ -470,6 +474,41 @@ class DirectEnvRead(Rule):
         return out
 
 
+class ClockDiscipline(Rule):
+    id = "LUX006"
+    title = "clock-discipline"
+    doc = ("serve/engine code takes timestamps through the obs helpers "
+           "(spans.clock for durations, spans.monotonic for deadlines), "
+           "not raw time.* — mixed clock sources make latency math and "
+           "trace alignment silently wrong")
+
+    _CLOCK_CALLS = {
+        "time.time", "time.perf_counter", "time.monotonic",
+        "time.perf_counter_ns", "time.monotonic_ns",
+    }
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        if "obs/" in ctx.posix_path:      # the helpers themselves
+            return False
+        return "serve/" in ctx.posix_path or "engine/" in ctx.posix_path
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name in self._CLOCK_CALLS:
+                out.append(self.finding(
+                    ctx, node,
+                    f"direct {name}() in serve/engine code — use "
+                    "lux_tpu.obs.spans.clock() (perf_counter, trace "
+                    "epoch) or spans.monotonic() (deadlines) so every "
+                    "latency shares one clock source",
+                ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         HostSyncInHotLoop(),
@@ -477,4 +516,5 @@ def all_rules() -> List[Rule]:
         KernelShapeContract(),
         EnvFlagRegistry(),
         DirectEnvRead(),
+        ClockDiscipline(),
     ]

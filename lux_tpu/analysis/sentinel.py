@@ -86,6 +86,13 @@ class RecompileSentinel:
         self._counts: Dict[Tuple[str, str], int] = {}
         self._lock = threading.Lock()
         self._tls = threading.local()
+        # Registered eagerly so a clean sentinel still exports the
+        # family at 0 — /metrics scrapes alert on the serve-phase count
+        # going nonzero, not on its absence.
+        metrics.counter(
+            "lux_xla_compiles_total",
+            {"scope": scope, "key": "_all", "phase": "serve"},
+        )
         with _SENTINELS_LOCK:
             _SENTINELS.add(self)
 

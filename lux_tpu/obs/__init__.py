@@ -10,10 +10,17 @@ Environment knobs (all optional; everything is a no-op when unset):
   (Perfetto-loadable via ``tools/trace_summary.py --to-chrome``).
 - ``LUX_LOG=<level>`` — log level for the ``lux.*`` categories,
   including the ``lux.perf`` run-report table.
+- ``LUX_SPANS=0`` — disable request-scoped serve spans (obs/spans.py;
+  default on).
+- ``LUX_FLIGHT_DIR=<dir>`` — arm the flight recorder (obs/flight.py):
+  ring-buffered traces + iteration records, ``flight.v1`` postmortem
+  dumps on shed/reject/exception/SIGUSR1.
+- ``LUX_FLIGHT_CAPACITY=<n>`` / ``LUX_STATUSZ_WINDOWS=<s,s>`` — flight
+  ring size and /statusz rolling-window lengths.
 """
 
 from ..utils import logging as _logging
-from . import metrics, report, trace
+from . import flight, metrics, report, slo, spans, trace
 from .iterlog import (
     NULL_RECORDER,
     IterationRecorder,
@@ -26,7 +33,7 @@ from .iterlog import (
 )
 
 __all__ = [
-    "metrics", "trace", "report",
+    "metrics", "trace", "report", "spans", "flight", "slo",
     "IterationRecorder", "NULL_RECORDER", "recorder_for",
     "telemetry_enabled", "gteps", "engine_label",
     "note_compile_seconds", "consume_compile_seconds",
@@ -38,4 +45,5 @@ def reconfigure():
     """Re-read LUX_TRACE and LUX_LOG after the environment changed
     (CLI flags set env vars post-import)."""
     trace.reconfigure()
+    flight.reconfigure()
     _logging.reconfigure()

@@ -23,7 +23,7 @@ from __future__ import annotations
 import time
 
 from ..utils import flags
-from . import metrics, trace
+from . import flight, metrics, trace
 
 
 def gteps(ne: int, iters: int, seconds: float) -> float:
@@ -64,7 +64,11 @@ NULL_RECORDER = _NullRecorder()
 
 
 def telemetry_enabled() -> bool:
-    return bool(flags.get("LUX_METRICS")) or trace.enabled()
+    # The flight recorder needs iteration records flowing even with no
+    # metrics path / trace writer: an armed LUX_FLIGHT_DIR turns the
+    # recorders on so in-flight sweeps appear in postmortems.
+    return bool(flags.get("LUX_METRICS")) or trace.enabled() \
+        or flight.enabled()
 
 
 def recorder_for(engine: str, graph, program=None):
@@ -189,6 +193,10 @@ class IterationRecorder:
             if residual is not None and j == n - 1:
                 rec["residual"] = float(residual)
             self.iterations.append(rec)
+            if flight.enabled():
+                flight.note_iteration({
+                    "engine": self.engine, "program": self.program, **rec,
+                })
         self._iters = iters_done
         trace.pair(f"{self.engine}.flush", now - dt, now, cat="execute",
                    args={"iters": n, "iters_done": iters_done})

@@ -191,6 +191,59 @@ class MetricsRegistry:
             self._metrics.clear()
 
 
+def _prom_label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(snap: Optional[list] = None) -> str:
+    """Prometheus text exposition (version 0.0.4) of a registry snapshot.
+
+    Dependency-free renderer for the serve ``/metrics`` endpoint: one
+    ``# TYPE`` line per metric family, histograms as CUMULATIVE
+    ``_bucket{le=...}`` series plus ``_sum``/``_count`` (the registry
+    stores per-bucket counts; Prometheus semantics require the running
+    total). Families sort by name, so scrapes diff cleanly.
+    """
+    if snap is None:
+        snap = REGISTRY.snapshot()
+    lines = []
+    typed = set()
+    for m in snap:
+        name, kind = m["name"], m["kind"]
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+        labels = m["labels"]
+        if kind in ("counter", "gauge"):
+            lines.append(
+                f"{name}{_prom_label_str(labels)} {_prom_num(m['value'])}"
+            )
+            continue
+        cum = 0
+        for b in m["buckets"]:
+            cum += b["count"]
+            le = b["le"] if b["le"] == "+Inf" else _prom_num(b["le"])
+            lines.append(
+                f"{name}_bucket{_prom_label_str(dict(labels, le=le))} {cum}"
+            )
+        lines.append(f"{name}_sum{_prom_label_str(labels)} "
+                     f"{repr(float(m['sum']))}")
+        lines.append(f"{name}_count{_prom_label_str(labels)} {m['count']}")
+    return "\n".join(lines) + "\n"
+
+
 REGISTRY = MetricsRegistry()
 
 # Module-level conveniences bound to the process registry.

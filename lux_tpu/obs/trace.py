@@ -122,6 +122,43 @@ def pair(name: str, t0: float, t1: float, cat: str = "lux", args: dict = None):
         _emit_locked(e)
 
 
+def async_begin(name: str, id_: str, cat: str = "lux", args: dict = None,
+                ts: float = None):
+    """Async-span start (ph "b"): events with one ``id`` form a request
+    lane in Perfetto regardless of which thread emits them — the serve
+    layer keys these by trace-id so one query's admission, batch, engine,
+    and cache phases line up even though three threads touch it."""
+    if _writer is None:
+        return
+    ev = _base(name, cat)
+    ev.update(ph="b", id=id_, ts=_now_us() if ts is None else ts)
+    if args:
+        ev["args"] = args
+    _emit(ev)
+
+
+def async_end(name: str, id_: str, cat: str = "lux", args: dict = None,
+              ts: float = None):
+    """Async-span end (ph "e"); matched to its "b" by (name, cat, id)."""
+    if _writer is None:
+        return
+    ev = _base(name, cat)
+    ev.update(ph="e", id=id_, ts=_now_us() if ts is None else ts)
+    if args:
+        ev["args"] = args
+    _emit(ev)
+
+
+def async_pair(name: str, id_: str, t0: float, t1: float, cat: str = "lux",
+               args: dict = None):
+    """Retrospective async span from two perf_counter stamps (the
+    queue-wait span is only known at dequeue)."""
+    if _writer is None:
+        return
+    async_begin(name, id_, cat, args, ts=(t0 - _EPOCH) * 1e6)
+    async_end(name, id_, cat, None, ts=(t1 - _EPOCH) * 1e6)
+
+
 def instant(name: str, cat: str = "lux", args: dict = None):
     if _writer is None:
         return

@@ -20,12 +20,11 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Hashable, List, Optional
 
-from lux_tpu.obs import metrics, trace
+from lux_tpu.obs import flight, metrics, spans
 from lux_tpu.serve.errors import DeadlineExceededError, QueueFullError
 
 # Batch sizes are small integers; the seconds-oriented default bucket
@@ -44,13 +43,16 @@ class Request:
     payload: Any
     batch_key: Optional[Hashable]
     future: Future = field(default_factory=Future)
-    deadline: Optional[float] = None      # time.monotonic() stamp
-    enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: Optional[float] = None      # spans.monotonic() stamp
+    enqueued_at: float = field(default_factory=spans.monotonic)
+    # Captured at construction on the admitting thread, so the batcher
+    # worker can continue the request's trace (spans.adopt).
+    trace_id: Optional[str] = field(default_factory=spans.current_trace_id)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
             return False
-        return (now if now is not None else time.monotonic()) > self.deadline
+        return (now if now is not None else spans.monotonic()) > self.deadline
 
 
 class MicroBatcher:
@@ -93,14 +95,21 @@ class MicroBatcher:
         """Admit ``req`` or raise ``QueueFullError`` without blocking."""
         if self._closed:
             raise QueueFullError("server is shutting down")
-        try:
-            self._q.put_nowait(req)
-        except queue.Full:
-            self._rejected.inc()
-            raise QueueFullError(
-                f"admission queue full ({self._q.maxsize} pending); retry"
-            ) from None
-        self._depth.set(self._q.qsize())
+        with spans.span("serve.admit", app=req.app):
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self._rejected.inc()
+                flight.dump(
+                    "queue_reject",
+                    detail=f"app={req.app} queue full "
+                           f"({self._q.maxsize} pending)",
+                )
+                raise QueueFullError(
+                    f"admission queue full ({self._q.maxsize} pending); "
+                    "retry"
+                ) from None
+            self._depth.set(self._q.qsize())
         return req.future
 
     # -- worker side -----------------------------------------------------
@@ -113,9 +122,9 @@ class MicroBatcher:
         batch = [first]
         if first.batch_key is None or self.max_batch == 1:
             return batch
-        t_close = time.monotonic() + self.window_s
+        t_close = spans.monotonic() + self.window_s
         while len(batch) < self.max_batch:
-            remaining = t_close - time.monotonic()
+            remaining = t_close - spans.monotonic()
             if remaining <= 0:
                 break
             try:
@@ -140,30 +149,49 @@ class MicroBatcher:
                     if self._closed:
                         return
                     continue
+            t_asm = spans.clock()
             batch = self._collect(first)
+            spans.complete(
+                "serve.batch_assemble", spans.clock() - t_asm,
+                trace_id=first.trace_id, app=first.app, size=len(batch),
+            )
             self._depth.set(self._q.qsize())
-            now = time.monotonic()
+            now = spans.monotonic()
             live = []
             for r in batch:
+                wait = max(0.0, now - r.enqueued_at)
                 if r.expired(now):
                     self._expired.inc()
+                    spans.complete("serve.queue_wait", wait,
+                                   trace_id=r.trace_id, app=r.app,
+                                   shed=True)
+                    flight.dump(
+                        "deadline_shed",
+                        detail=f"app={r.app} waited {wait:.3f}s in queue",
+                    )
                     r.future.set_exception(DeadlineExceededError(
-                        f"deadline expired after "
-                        f"{now - r.enqueued_at:.3f}s in queue"
+                        f"deadline expired after {wait:.3f}s in queue"
                     ))
                 else:
+                    spans.complete("serve.queue_wait", wait,
+                                   trace_id=r.trace_id, app=r.app)
                     live.append(r)
             if not live:
                 continue
             self._batch_hist.observe(len(live))
-            with trace.span("serve.batch", cat="serve",
-                            app=live[0].app, size=len(live)):
-                try:
-                    self._execute(live)
-                except Exception as e:  # engine bug: fail the batch, keep serving
-                    for r in live:
-                        if not r.future.done():
-                            r.future.set_exception(e)
+            # The lead request's trace owns the engine-side spans: one
+            # trace in the batch shows the full admission->batch->engine
+            # ->cache chain (the serve_smoke acceptance assertion).
+            with spans.adopt(live[0].trace_id):
+                with spans.span("serve.batch", app=live[0].app,
+                                size=len(live)):
+                    try:
+                        self._execute(live)
+                    except Exception as e:  # engine bug: fail the batch, keep serving
+                        flight.dump("engine_exception", detail=repr(e))
+                        for r in live:
+                            if not r.future.done():
+                                r.future.set_exception(e)
 
     def close(self, timeout: float = 5.0):
         """Stop admitting, drain the worker, fail leftover requests."""
@@ -175,6 +203,10 @@ class MicroBatcher:
             except queue.Empty:
                 break
             r.future.set_exception(QueueFullError("server shut down"))
+
+    def batch_histogram(self) -> dict:
+        """Snapshot of the achieved batch-width histogram (/statusz)."""
+        return self._batch_hist.snapshot()
 
     def stats(self) -> dict:
         return {

@@ -14,7 +14,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
-from lux_tpu.obs import metrics
+from lux_tpu.obs import metrics, spans
 
 
 class ResultCache:
@@ -35,17 +35,21 @@ class ResultCache:
             if key in self._d:
                 self._d.move_to_end(key)
                 self._hits.inc()
-                return self._d[key]
-            self._misses.inc()
-            return None
+                hit, out = True, self._d[key]
+            else:
+                self._misses.inc()
+                hit, out = False, None
+        spans.complete("serve.cache.get", 0.0, hit=hit)
+        return out
 
     def put(self, key: Hashable, value: Any) -> None:
-        with self._lock:
-            self._d[key] = value
-            self._d.move_to_end(key)
-            while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
-                self._evictions.inc()
+        with spans.span("serve.cache.put"):
+            with self._lock:
+                self._d[key] = value
+                self._d.move_to_end(key)
+                while len(self._d) > self.capacity:
+                    self._d.popitem(last=False)
+                    self._evictions.inc()
 
     def __len__(self) -> int:
         with self._lock:

@@ -8,9 +8,20 @@ Endpoints:
   value array — gated by a size cap so a misdirected client cannot pull
   multi-GB arrays through JSON). Default response carries summary stats
   only.
-- ``GET /healthz`` — liveness + graph identity (nv, ne, fingerprint).
+- ``GET /healthz`` — liveness: graph identity (nv, ne, fingerprint),
+  pool warmth, device reachability.
 - ``GET /stats`` — pool/cache/batcher counters + latency quantiles.
-- ``GET /metrics`` — full `obs` metrics-registry snapshot (JSON).
+- ``GET /metrics`` — Prometheus text exposition of the `obs` registry
+  (``lux_xla_compiles_total``, ``lux_ir_findings_total``, span
+  histograms, ...); ``GET /metrics.json`` keeps the JSON snapshot.
+- ``GET /statusz`` — rolling 1-min/5-min SLO windows (p50/p95/p99 per
+  app), queue depth, cache hit rate, batch-width histogram, shed and
+  recompile counters (JSON; windows set by ``LUX_STATUSZ_WINDOWS``).
+
+Every ``POST /query`` runs under a root request span (obs/spans.py):
+the response carries the trace-id in ``X-Lux-Trace``, and the same id
+keys the request's async lane in the Chrome trace. ``SIGUSR1`` (CLI
+mode) dumps a flight.v1 postmortem to ``LUX_FLIGHT_DIR``.
 
 Error mapping: ``BadQueryError`` → 400, ``QueueFullError`` → 429,
 ``DeadlineExceededError`` → 504 (serve/errors.py owns the taxonomy).
@@ -30,9 +41,10 @@ from typing import Optional
 
 import numpy as np
 
-from lux_tpu.obs import metrics
+from lux_tpu.obs import flight, metrics, spans
 from lux_tpu.serve.errors import ServeError, BadQueryError
 from lux_tpu.serve.session import ServeConfig, Session
+from lux_tpu.utils import flags
 from lux_tpu.utils.logging import get_logger
 
 # Above this many vertices, "full": true is refused; use "targets".
@@ -84,13 +96,25 @@ class _Handler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
 
-    def _reply(self, status: int, payload: dict):
+    def _reply(self, status: int, payload: dict,
+               trace_id: str = None):
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if trace_id:
+            self.send_header("X-Lux-Trace", trace_id)
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_text(self, status: int, body: str,
+                    content_type: str = "text/plain; version=0.0.4"):
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def log_message(self, fmt, *args):   # route through lux logging
         if self.log is not None:
@@ -99,13 +123,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         s = self.session
         if self.path == "/healthz":
-            self._reply(200, {
-                "ok": True, "nv": s.graph.nv, "ne": s.graph.ne,
+            pool_warm = len(s.pool) > 0
+            try:
+                import jax
+
+                device = jax.devices()[0].platform
+            except Exception:
+                device = None
+            self._reply(200 if pool_warm else 503, {
+                "ok": bool(pool_warm), "nv": s.graph.nv, "ne": s.graph.ne,
                 "fingerprint": s.fingerprint,
+                "pool_warm": pool_warm, "engines": len(s.pool),
+                "device": device,
             })
         elif self.path == "/stats":
             self._reply(200, s.stats())
+        elif self.path == "/statusz":
+            self._reply(200, s.statusz())
         elif self.path == "/metrics":
+            self._reply_text(200, metrics.render_prometheus())
+        elif self.path == "/metrics.json":
             self._reply(200, {"metrics": metrics.snapshot()})
         else:
             self._reply(404, {"error": f"no such endpoint {self.path}"})
@@ -114,30 +151,37 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/query":
             self._reply(404, {"error": f"no such endpoint {self.path}"})
             return
-        try:
-            n = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(n) or b"{}")
-            if not isinstance(body, dict):
-                raise BadQueryError("body must be a JSON object")
-            app = body.get("app")
-            params = {
-                k: v for k, v in body.items()
-                if k in ("start", "ni")
-            }
-            result = self.session.query(
-                app, deadline_s=body.get("deadline_s"), **params
-            )
-            self._reply(
-                200, render_result(result, body, self.session.graph.nv)
-            )
-        except ServeError as e:
-            self._reply(e.http_status, {
-                "error": str(e), "kind": type(e).__name__,
-            })
-        except json.JSONDecodeError as e:
-            self._reply(400, {"error": f"bad JSON: {e}", "kind": "BadQueryError"})
-        except Exception as e:   # engine bug: surface, keep serving
-            self._reply(500, {"error": str(e), "kind": type(e).__name__})
+        # The ROOT span of the request trace: handler-thread work plus
+        # (via the Future the session blocks on) the batcher/engine
+        # spans that adopt this trace-id on other threads.
+        with spans.span("http.request", path=self.path) as tid:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise BadQueryError("body must be a JSON object")
+                app = body.get("app")
+                params = {
+                    k: v for k, v in body.items()
+                    if k in ("start", "ni")
+                }
+                result = self.session.query(
+                    app, deadline_s=body.get("deadline_s"), **params
+                )
+                self._reply(
+                    200, render_result(result, body, self.session.graph.nv),
+                    trace_id=tid,
+                )
+            except ServeError as e:
+                self._reply(e.http_status, {
+                    "error": str(e), "kind": type(e).__name__,
+                }, trace_id=tid)
+            except json.JSONDecodeError as e:
+                self._reply(400, {"error": f"bad JSON: {e}",
+                                  "kind": "BadQueryError"}, trace_id=tid)
+            except Exception as e:   # engine bug: surface, keep serving
+                self._reply(500, {"error": str(e),
+                                  "kind": type(e).__name__}, trace_id=tid)
 
     # query() futures raise ServeError subclasses; unwrap happens via
     # Future.result() re-raising them directly, so do_POST's except
@@ -195,6 +239,9 @@ def main(argv: Optional[list] = None) -> int:
     )
     session = Session(args.file, cfg)
     server = make_server(session, args.host, args.port)
+    if flight.install_signal_handler():
+        log.info("SIGUSR1 -> flight.v1 postmortem (LUX_FLIGHT_DIR=%s)",
+                 flags.get("LUX_FLIGHT_DIR"))
     log.info(
         "serving %s (nv=%d ne=%d) on http://%s:%d  "
         "[max_batch=%d window=%.1fms queue=%d]",

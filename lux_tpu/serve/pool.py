@@ -21,7 +21,7 @@ import threading
 from typing import Callable, Hashable
 
 from lux_tpu.analysis.sentinel import RecompileSentinel
-from lux_tpu.obs import metrics, trace
+from lux_tpu.obs import metrics, spans
 from lux_tpu.utils import flags
 
 
@@ -51,8 +51,10 @@ class EnginePool:
                 self._hits.inc()
                 return ex
             self._misses.inc()
-            with trace.span("serve.engine_build", cat="serve",
-                            key=str(key)):
+            # spans.span (not trace.span): a build triggered by a live
+            # request joins that request's trace; warmup builds root
+            # their own.
+            with spans.span("serve.engine_build", key=str(key)):
                 with self.sentinel.expect(key):
                     ex = factory()
                     if hasattr(ex, "warmup"):

@@ -23,14 +23,13 @@ from __future__ import annotations
 
 import contextlib
 import threading
-import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Union
 
 import numpy as np
 
 from lux_tpu.graph.graph import Graph
-from lux_tpu.obs import metrics, trace
+from lux_tpu.obs import flight, metrics, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
 from lux_tpu.serve.cache import ResultCache
 from lux_tpu.serve.errors import BadQueryError
@@ -95,8 +94,11 @@ class Session:
         )
         self._requests = metrics.counter("lux_serve_requests_total")
         self._latency = metrics.histogram("lux_serve_request_seconds")
+        self.slo = slo.SloWindows()
         self._served_keys = set()   # batcher-thread only
         self._closed = False
+        self._flight_name = f"session:{self.fingerprint[:12]}"
+        flight.add_context(self._flight_name, self._flight_context)
         if warm:
             self.warmup()
 
@@ -164,7 +166,7 @@ class Session:
         """Build + compile every served engine before traffic arrives.
         After this, the pool miss counter is the recompile count: the
         smoke test asserts it stays flat across the query phase."""
-        with trace.span("serve.warmup", cat="serve"):
+        with spans.span("serve.warmup"):
             with _timed(self.log, "warmup sssp single"):
                 self._sssp_single()
             with _timed(self.log, "warmup sssp multi"):
@@ -201,27 +203,52 @@ class Session:
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         deadline = (
-            time.monotonic() + deadline_s if deadline_s is not None else None
+            spans.monotonic() + deadline_s if deadline_s is not None
+            else None
         )
-        t0 = time.perf_counter()
+        t0 = spans.clock()
+        # Programmatic callers have no HTTP root span: the session mints
+        # the trace and closes its record when the future resolves, so
+        # batcher/engine spans still share one trace-id.
+        finish = None
+        token = None
+        if spans.current_trace_id() is None and spans.enabled():
+            tid, finish = spans.open_trace()
+            token = spans.activate(tid)
+        try:
+            if app == "sssp":
+                fut = self._submit_sssp(params, deadline)
+            elif app == "components":
+                fut = self._submit_cached_fixpoint(
+                    app, ("components",), self._run_components, deadline
+                )
+            else:
+                ni = int(params.get("ni", self.config.pagerank_iters))
+                if ni < 1:
+                    raise BadQueryError(
+                        f"pagerank ni must be >= 1 (got {ni})"
+                    )
+                fut = self._submit_cached_fixpoint(
+                    app, ("pagerank", ni),
+                    lambda: self._run_pagerank(ni), deadline,
+                )
+        except BaseException:
+            if token is not None:
+                spans.deactivate(token)
+            if finish is not None:
+                finish()
+            raise
+        if token is not None:
+            spans.deactivate(token)
 
-        if app == "sssp":
-            fut = self._submit_sssp(params, deadline)
-        elif app == "components":
-            fut = self._submit_cached_fixpoint(
-                app, ("components",), self._run_components, deadline
-            )
-        else:
-            ni = int(params.get("ni", self.config.pagerank_iters))
-            if ni < 1:
-                raise BadQueryError(f"pagerank ni must be >= 1 (got {ni})")
-            fut = self._submit_cached_fixpoint(
-                app, ("pagerank", ni),
-                lambda: self._run_pagerank(ni), deadline,
-            )
-        fut.add_done_callback(
-            lambda f: self._latency.observe(time.perf_counter() - t0)
-        )
+        def _done(f, app=app, t0=t0, finish=finish):
+            dt = spans.clock() - t0
+            self._latency.observe(dt)
+            self.slo.observe(app, dt)
+            if finish is not None:
+                finish()
+
+        fut.add_done_callback(_done)
         return fut
 
     def query(self, app: str, timeout: Optional[float] = None, **params):
@@ -295,7 +322,8 @@ class Session:
         if len(batch) == 1:
             key = self._engine_key("push", ("sssp", 1))
             ex = self._sssp_single()
-            with self._watched(key):
+            with self._watched(key), spans.span(
+                    "serve.engine", app="sssp", engine="push", lanes=1):
                 state, iters = ex.run(start=roots[0])
                 results = [np.asarray(state.values)]
         else:
@@ -303,7 +331,9 @@ class Session:
                 "push_multi", ("sssp", self.config.max_batch)
             )
             ex = self._sssp_multi()
-            with self._watched(key):
+            with self._watched(key), spans.span(
+                    "serve.engine", app="sssp", engine="push_multi",
+                    lanes=len(roots)):
                 state, iters = ex.run(roots)
                 results = [
                     ex.values_for(state, j) for j in range(len(roots))
@@ -315,7 +345,9 @@ class Session:
 
     def _run_components(self) -> dict:
         ex = self._components_engine()
-        with self._watched(self._engine_key("push", ("components", 1))):
+        with self._watched(self._engine_key("push", ("components", 1))), \
+                spans.span("serve.engine", app="components",
+                           engine="push"):
             state, iters = ex.run()
         return {"values": np.asarray(state.values), "iters": int(iters)}
 
@@ -323,7 +355,9 @@ class Session:
         from lux_tpu.models.cli import final_values
 
         ex = self._pagerank_engine()
-        with self._watched(self._engine_key("pull", ("pagerank",))):
+        with self._watched(self._engine_key("pull", ("pagerank",))), \
+                spans.span("serve.engine", app="pagerank", engine="pull",
+                           iters=ni):
             vals = ex.run(ni)
         return {"values": final_values(ex, vals), "iters": ni}
 
@@ -346,9 +380,46 @@ class Session:
             }
         return s
 
+    def statusz(self) -> dict:
+        """Rolling operational view (the /statusz payload): windowed
+        SLO quantiles per app, queue pressure, cache efficiency, batch
+        width, and the shed/reject/recompile counters that page."""
+        b = self.batcher.stats()
+        c = self.cache.stats()
+        p = self.pool.stats()
+        probes = c["hits"] + c["misses"]
+        return {
+            "windows": self.slo.snapshot(),
+            "queue": {"depth": b["queue_depth"],
+                      "capacity": b["queue_capacity"]},
+            "cache_hit_rate": (c["hits"] / probes) if probes else None,
+            "batch_size": self.batcher.batch_histogram(),
+            "counters": {
+                "requests": int(self._requests.value),
+                "rejected": b["rejected"],
+                "deadline_expired": b["deadline_expired"],
+                "warmup_compiles": p["warmup_compiles"],
+                "recompiles": p["recompiles"],
+                "ir_findings": p["ir_findings"],
+            },
+            "flight": flight.counts(),
+        }
+
+    def _flight_context(self) -> dict:
+        """Context block stamped into every flight.v1 postmortem."""
+        return {
+            "graph": {"nv": self.graph.nv, "ne": self.graph.ne,
+                      "fingerprint": self.fingerprint},
+            "pool": self.pool.stats(),
+            "cache": self.cache.stats(),
+            "batcher": self.batcher.stats(),
+            "sentinel": self.pool.sentinel.stats(),
+        }
+
     def close(self):
         if not self._closed:
             self._closed = True
+            flight.remove_context(self._flight_name)
             self.batcher.close()
             self.pool.close()
 
@@ -364,9 +435,9 @@ class _timed:
         self.log, self.what = log, what
 
     def __enter__(self):
-        self.t0 = time.perf_counter()
+        self.t0 = spans.clock()
 
     def __exit__(self, *exc):
         self.log.info(
-            "%s: %.2fs", self.what, time.perf_counter() - self.t0
+            "%s: %.2fs", self.what, spans.clock() - self.t0
         )

@@ -148,6 +148,18 @@ define("LUX_METRICS", None,
        "run to this path", kind="path")
 define("LUX_TRACE", None,
        "stream Chrome trace_event JSON-lines to this path", kind="path")
+define("LUX_SPANS", True,
+       "request-scoped serve spans (obs/spans.py): trace-id propagation, "
+       "per-phase histograms, async Chrome events (0 disables)",
+       kind="bool")
+define("LUX_FLIGHT_DIR", None,
+       "arm the flight recorder (obs/flight.py): postmortem flight.v1 "
+       "JSON dumps land in this directory", kind="path")
+define("LUX_FLIGHT_CAPACITY", 256,
+       "flight-recorder ring size: last N completed traces and last N "
+       "engine iteration records kept for postmortems", kind="int")
+define("LUX_STATUSZ_WINDOWS", "60,300",
+       "/statusz rolling SLO window lengths in seconds, comma-separated")
 
 # Backend / native toolchain (utils/platform.py, native/build.py)
 define("LUX_PLATFORM", None,

@@ -1,0 +1,11 @@
+"""LUX006 fixture. The `serve/` path component puts it in scope; every
+raw time.* read must be flagged, whatever it feeds."""
+import time
+
+
+def handle(req, window_s):
+    t0 = time.perf_counter()                   # expect: LUX006
+    deadline = time.monotonic() + window_s     # expect: LUX006
+    stamp = time.time()                        # expect: LUX006
+    ns = time.perf_counter_ns()                # expect: LUX006
+    return t0, deadline, stamp, ns

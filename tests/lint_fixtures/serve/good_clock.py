@@ -1,0 +1,12 @@
+"""LUX006 fixture: serve code stamping time through the obs helpers —
+one clock source for durations (trace epoch) and one for deadlines."""
+import time
+
+from lux_tpu.obs import spans
+
+
+def handle(req, window_s):
+    t0 = spans.clock()
+    deadline = spans.monotonic() + window_s
+    time.sleep(0.0)            # sleeping is not reading a clock
+    return spans.clock() - t0, deadline

@@ -32,12 +32,14 @@ BAD_FIXTURES = (
     "bad_recompile.py",
     "ops/bad_kernel_specs.py",
     "lux_tpu/bad_envflag.py",
+    "serve/bad_clock.py",
 )
 GOOD_FIXTURES = (
     "engine/good_host_sync.py",
     "good_recompile.py",
     "ops/good_kernel_specs.py",
     "lux_tpu/good_envflag.py",
+    "serve/good_clock.py",
 )
 
 

@@ -1,0 +1,311 @@
+"""Request-scoped spans, Prometheus exposition, rolling SLO windows, and
+the flight-recorder postmortem pipeline (ISSUE 6).
+
+The serve-layer smoke (`make serve-obs` / tools/serve_smoke.py) proves
+the integrated story over HTTP; these tests pin the component contracts:
+trace-id propagation across the batcher's worker thread, exposition
+format, window quantile math on a seeded stream, dump triggers, and the
+bounded-memory ring property.
+"""
+
+import json
+import threading
+
+import pytest
+
+from lux_tpu.obs import flight, metrics, slo, spans, trace
+from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.errors import DeadlineExceededError
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(monkeypatch):
+    """Every test starts with telemetry env clean, empty registry, and
+    empty flight rings; mutations are undone and re-read at teardown."""
+    for var in ("LUX_METRICS", "LUX_TRACE", "LUX_FLIGHT_DIR",
+                "LUX_FLIGHT_CAPACITY", "LUX_SPANS",
+                "LUX_STATUSZ_WINDOWS"):
+        monkeypatch.delenv(var, raising=False)
+    trace.reconfigure()
+    flight.reconfigure()
+    flight.reset()
+    metrics.reset()
+    yield
+    monkeypatch.undo()
+    trace.reconfigure()
+    flight.reconfigure()
+    flight.reset()
+    metrics.reset()
+
+
+@pytest.fixture()
+def sink():
+    """Collect completed trace records from the spans layer."""
+    records = []
+    spans.add_sink(records.append)
+    yield records
+    spans.remove_sink(records.append)
+
+
+# -- span API -------------------------------------------------------------
+
+
+def test_span_nesting_one_record_per_root(sink):
+    with spans.span("outer", app="t") as tid:
+        assert tid and spans.current_trace_id() == tid
+        with spans.span("inner") as inner_tid:
+            assert inner_tid == tid          # nested spans share the trace
+    assert spans.current_trace_id() is None  # context restored
+
+    assert len(sink) == 1
+    rec = sink[0]
+    assert rec["trace_id"] == tid
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert set(by_name) == {"outer", "inner"}
+    assert by_name["outer"]["dur_s"] >= by_name["inner"]["dur_s"]
+    assert by_name["outer"]["attrs"] == {"app": "t"}
+    assert rec["duration_s"] >= 0
+
+    # Per-phase histograms landed in the registry.
+    snap = {m["name"]: m for m in metrics.snapshot()}
+    assert snap["lux_span_seconds"]["count"] >= 1
+
+
+def test_spans_disabled_by_flag(monkeypatch, sink):
+    monkeypatch.setenv("LUX_SPANS", "0")
+    with spans.span("x") as tid:
+        assert tid is None
+        assert spans.current_trace_id() is None
+    assert sink == []
+
+
+def test_trace_id_propagates_across_batcher_thread(sink):
+    """The admitting thread's trace-id must reach the batcher worker:
+    Request captures it, the worker adopts it, and the engine-side work
+    sees the same id (the one-trace-per-request chain)."""
+    seen = {}
+    done = threading.Event()
+
+    def execute(batch):
+        seen["worker_tid"] = spans.current_trace_id()
+        seen["worker_thread"] = threading.current_thread().name
+        for r in batch:
+            r.future.set_result("ok")
+        done.set()
+
+    b = MicroBatcher(execute, max_batch=1, window_s=0.001, max_queue=8)
+    try:
+        with spans.span("root", app="t") as tid:
+            fut = b.submit(Request(app="t", payload=None, batch_key=None))
+            assert fut.result(10) == "ok"
+            assert done.wait(10)
+    finally:
+        b.close()
+
+    assert seen["worker_tid"] == tid
+    assert seen["worker_thread"] != threading.current_thread().name
+    rec = next(r for r in sink if r["trace_id"] == tid)
+    names = {s["name"] for s in rec["spans"]}
+    assert {"root", "serve.admit", "serve.queue_wait"} <= names
+
+
+# -- Prometheus exposition ------------------------------------------------
+
+
+def _parse_prometheus(text):
+    """The ~10-line parser the exposition must survive."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, value = line.rsplit(" ", 1)
+        name, _, labels = series.partition("{")
+        out[(name, labels.rstrip("}"))] = float(value)
+    return out
+
+
+def test_metrics_prometheus_exposition_parses():
+    metrics.counter("t_reqs", {"app": "sssp"}).inc(3)
+    metrics.gauge("t_depth").set(7)
+    h = metrics.histogram("t_lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    text = metrics.render_prometheus()
+    assert text.endswith("\n")
+    samples = _parse_prometheus(text)
+
+    assert samples[("t_reqs", 'app="sssp"')] == 3
+    assert samples[("t_depth", "")] == 7
+    # Buckets are CUMULATIVE and capped by the +Inf bucket == count.
+    assert samples[("t_lat_bucket", 'le="0.1"')] == 1
+    assert samples[("t_lat_bucket", 'le="1"')] == 2
+    assert samples[("t_lat_bucket", 'le="+Inf"')] == 3
+    assert samples[("t_lat_count", "")] == 3
+    assert samples[("t_lat_sum", "")] == pytest.approx(5.55)
+    # One TYPE line per family.
+    types = [l for l in text.splitlines() if l.startswith("# TYPE t_lat ")]
+    assert types == ["# TYPE t_lat histogram"]
+
+
+def test_prometheus_label_escaping():
+    metrics.counter("t_esc", {"k": 'a"b\\c\nd'}).inc()
+    text = metrics.render_prometheus()
+    assert '{k="a\\"b\\\\c\\nd"}' in text
+
+
+# -- rolling SLO windows --------------------------------------------------
+
+
+def test_slo_window_math_with_seeded_stream():
+    clock = [1000.0]
+    w = slo.SloWindows(windows=(60.0, 300.0), now=lambda: clock[0])
+
+    # 100 observations, one per second: latency i ms at t=1000+i.
+    for i in range(100):
+        clock[0] = 1000.0 + i
+        w.observe("sssp", i / 1000.0)
+    clock[0] = 1099.0   # time of the last observation
+
+    snap = w.snapshot()
+    assert set(snap) == {"60s", "300s"}
+    # 300s window holds all 100 points: p50 of 0..99ms.
+    full = snap["300s"]["sssp"]
+    assert full["count"] == 100
+    assert full["p50"] == pytest.approx(0.0495, abs=1e-4)
+    assert full["p99"] == pytest.approx(0.09801, abs=1e-4)
+    # 60s window holds t in [1039, 1099] -> latencies 39..99ms (61 pts).
+    recent = snap["60s"]["sssp"]
+    assert recent["count"] == 61
+    assert recent["p50"] == pytest.approx(0.069, abs=1e-4)
+    assert recent["p95"] == pytest.approx(0.096, abs=1e-4)
+
+    # Everything ages out.
+    clock[0] = 3000.0
+    assert w.snapshot()["300s"] == {}
+
+
+def test_slo_windows_from_flags(monkeypatch):
+    monkeypatch.setenv("LUX_STATUSZ_WINDOWS", "10, 60,10")
+    assert slo.windows_from_flags() == (10.0, 60.0)
+    monkeypatch.setenv("LUX_STATUSZ_WINDOWS", "garbage")
+    assert slo.windows_from_flags() == (60.0, 300.0)
+
+
+# -- flight recorder ------------------------------------------------------
+
+
+def _stalled_batcher(max_queue=8, fail=None):
+    release = threading.Event()
+    started = threading.Event()
+
+    def execute(batch):
+        started.set()
+        release.wait(10)
+        if fail is not None:
+            raise fail
+        for r in batch:
+            r.future.set_result("done")
+
+    b = MicroBatcher(execute, max_batch=1, window_s=0.01,
+                     max_queue=max_queue)
+    return b, release, started
+
+
+def _arm(monkeypatch, tmp_path):
+    d = tmp_path / "flight"
+    monkeypatch.setenv("LUX_FLIGHT_DIR", str(d))
+    flight.reconfigure()
+    return d
+
+
+def _dumps(d):
+    return sorted(d.glob("flight-*.json")) if d.exists() else []
+
+
+def test_flight_dump_on_deadline_shed(monkeypatch, tmp_path, sink):
+    d = _arm(monkeypatch, tmp_path)
+    with spans.span("doomed"):
+        pass                       # one completed trace in the ring
+    b, release, started = _stalled_batcher()
+    try:
+        blocker = b.submit(Request(app="x", payload=None, batch_key=None))
+        assert started.wait(5)
+        expired = b.submit(Request(
+            app="x", payload=None, batch_key=None,
+            deadline=spans.monotonic() - 0.001,
+        ))
+        release.set()
+        with pytest.raises(DeadlineExceededError):
+            expired.result(10)
+        blocker.result(10)
+    finally:
+        release.set()
+        b.close()
+
+    files = _dumps(d)
+    assert len(files) == 1
+    doc = json.loads(files[0].read_text())
+    assert doc["schema"] == "flight.v1"
+    assert doc["reason"] == "deadline_shed"
+    assert "waited" in doc["detail"]
+    assert any(t.get("spans") for t in doc["traces"])
+    assert isinstance(doc["metrics"], list) and doc["flags"]
+    assert doc["flags"]["LUX_FLIGHT_DIR"] == str(d)
+
+
+def test_flight_dump_on_engine_exception(monkeypatch, tmp_path):
+    d = _arm(monkeypatch, tmp_path)
+    boom = RuntimeError("engine exploded")
+    b, release, started = _stalled_batcher(fail=boom)
+    try:
+        fut = b.submit(Request(app="x", payload=None, batch_key=None))
+        assert started.wait(5)
+        release.set()
+        with pytest.raises(RuntimeError, match="engine exploded"):
+            fut.result(10)
+    finally:
+        release.set()
+        b.close()
+
+    files = _dumps(d)
+    assert len(files) == 1
+    doc = json.loads(files[0].read_text())
+    assert doc["reason"] == "engine_exception"
+    assert "engine exploded" in doc["detail"]
+
+
+def test_flight_dump_debounced_and_forced(monkeypatch, tmp_path):
+    d = _arm(monkeypatch, tmp_path)
+    assert flight.dump("storm") is not None
+    assert flight.dump("storm") is None          # within debounce window
+    assert flight.dump("other_reason") is not None   # per-reason debounce
+    assert flight.dump("storm", force=True) is not None
+    assert len(_dumps(d)) == 3
+
+
+def test_flight_unarmed_is_inert(tmp_path):
+    assert not flight.enabled()
+    assert flight.dump("ignored") is None
+    spans_before = flight.counts()
+    with spans.span("unrecorded"):
+        pass
+    assert flight.counts() == spans_before
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_ring_is_bounded(monkeypatch, tmp_path):
+    monkeypatch.setenv("LUX_FLIGHT_CAPACITY", "4")
+    _arm(monkeypatch, tmp_path)
+    for i in range(100):
+        with spans.span("burst", i=i):
+            pass
+        flight.note_iteration({"iteration": i})
+    c = flight.counts()
+    assert c == {"traces": 4, "iterations": 4, "capacity": 4}
+    # The ring keeps the NEWEST records.
+    path = flight.dump("overflow", force=True)
+    doc = json.loads(open(path).read())
+    kept = [t["spans"][0]["attrs"]["i"] for t in doc["traces"]]
+    assert kept == [96, 97, 98, 99]
+    assert [r["iteration"] for r in doc["iterations"]] == [96, 97, 98, 99]

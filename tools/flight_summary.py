@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Render a flight.v1 postmortem dump (obs/flight.py) for humans.
+
+The flight recorder writes self-contained JSON: the last N completed
+request traces, the last N engine iteration records, a metrics-registry
+snapshot, per-component context (sentinel/pool/batcher/cache stats),
+and the LUX_* flag table — everything needed to ask "what was the
+server doing when it shed that request" without reproducing anything.
+
+    python tools/flight_summary.py /var/tmp/flight/flight-...-deadline_shed.json
+    python tools/flight_summary.py /var/tmp/flight            # latest dump
+    python tools/flight_summary.py dump.json --traces 5 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def resolve(path: str) -> str:
+    """A dump file, or the newest flight-*.json inside a directory."""
+    if os.path.isdir(path):
+        cands = sorted(
+            f for f in os.listdir(path)
+            if f.startswith("flight-") and f.endswith(".json")
+        )
+        if not cands:
+            raise SystemExit(f"flight_summary: no flight-*.json in {path}")
+        # Filenames embed a ms timestamp, so lexicographic == temporal.
+        return os.path.join(path, cands[-1])
+    return path
+
+
+def load(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != "flight.v1":
+        raise SystemExit(
+            f"flight_summary: {path} is not a flight.v1 dump "
+            f"(schema={doc.get('schema')!r})"
+        )
+    return doc
+
+
+def fmt_trace(t: dict) -> list:
+    lines = [f"  trace {t.get('trace_id')}  "
+             f"total {t.get('duration_s', 0) * 1e3:.2f} ms"]
+    for s in t.get("spans", []):
+        attrs = s.get("attrs") or {}
+        extra = "  " + " ".join(
+            f"{k}={v}" for k, v in sorted(attrs.items())
+        ) if attrs else ""
+        lines.append(
+            f"    {s.get('dur_s', 0) * 1e3:9.3f} ms  {s.get('name'):<22}"
+            f" [{s.get('thread', '?')}]{extra}"
+        )
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="flight.v1 dump file, or a directory "
+                    "(LUX_FLIGHT_DIR) to pick the latest from")
+    ap.add_argument("--traces", type=int, default=3,
+                    help="newest traces to expand (default 3)")
+    ap.add_argument("--iters", type=int, default=8,
+                    help="newest iteration records to list (default 8)")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the parsed dump as one JSON line "
+                    "(validation / piping)")
+    args = ap.parse_args()
+
+    path = resolve(args.path)
+    doc = load(path)
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+        return 0
+
+    print(f"flight.v1  {path}")
+    print(f"reason: {doc.get('reason')}"
+          + (f"  ({doc['detail']})" if doc.get("detail") else ""))
+    print(f"pid {doc.get('pid')}  unix_time {doc.get('unix_time_s')}")
+
+    traces = doc.get("traces") or []
+    iters = doc.get("iterations") or []
+    print(f"\ntraces: {len(traces)} recorded "
+          f"(showing newest {min(args.traces, len(traces))})")
+    for t in traces[-args.traces:]:
+        for line in fmt_trace(t):
+            print(line)
+
+    print(f"\niterations: {len(iters)} recorded "
+          f"(showing newest {min(args.iters, len(iters))})")
+    for r in iters[-args.iters:]:
+        wall = r.get("t_iter_s")
+        wall_str = f"{wall * 1e3:9.3f} ms" if isinstance(
+            wall, (int, float)) else f"{'?':>9}   "
+        print(f"  {wall_str}  {r.get('engine', '?'):<12} "
+              f"{r.get('program', '?'):<12} iter={r.get('iter', '?')} "
+              f"frontier={r.get('frontier', '?')}")
+
+    ctx = doc.get("context") or {}
+    if ctx:
+        print("\ncontext:")
+        for name, val in sorted(ctx.items()):
+            blob = json.dumps(val, sort_keys=True, default=str)
+            print(f"  {name}: {blob}")
+
+    m = doc.get("metrics") or []
+    interesting = [x for x in m if x["kind"] != "histogram"
+                   and float(x.get("value", 0)) != 0]
+    if interesting:
+        print(f"\nmetrics (nonzero counters/gauges, of {len(m)} total):")
+        for x in interesting:
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(x["labels"].items()))
+            print(f"  {x['name']}{'{' + lbl + '}' if lbl else ''} "
+                  f"= {x['value']}")
+
+    fl = doc.get("flags") or {}
+    set_flags = {k: v for k, v in sorted(fl.items()) if v is not None}
+    if set_flags:
+        print("\nflags (set in environment):")
+        for k, v in set_flags.items():
+            print(f"  {k}={v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

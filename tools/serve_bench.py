@@ -4,14 +4,18 @@
 Spawns N worker threads, each issuing queries back-to-back (closed loop)
 or paced to a per-worker QPS budget, against an in-process Session (the
 default: measures engine+batcher latency without socket noise) or a
-remote server via --url (measures the full HTTP path). Prints p50/p99
-latency per app, throughput, and the achieved batch-size histogram from
-the `obs` registry — the evidence format PERF.md specifies for serving
-claims.
+remote server via --url (measures the full HTTP path). Prints
+p50/p95/p99 latency per app, throughput, and the achieved batch-size
+histogram from the `obs` registry, and (with --json / --json-out) emits
+a schema-versioned ``serve_bench.v1`` report — the evidence format
+PERF.md specifies for serving claims, checkable against a baseline via
+tools/slo_check.py (`make serve-slo`).
 
 Examples:
   python tools/serve_bench.py --scale 12 --workers 16 --duration 10
   python tools/serve_bench.py --url http://127.0.0.1:8399 --workers 32
+  python tools/serve_bench.py --json-out /tmp/bench.json && \
+      python tools/slo_check.py --input /tmp/bench.json --baseline slo.json
 """
 
 from __future__ import annotations
@@ -52,12 +56,20 @@ class HttpClient:
     def batch_histogram(self):
         import urllib.request
 
-        with urllib.request.urlopen(self.url + "/metrics", timeout=10) as r:
+        with urllib.request.urlopen(
+            self.url + "/metrics.json", timeout=10
+        ) as r:
             snap = json.loads(r.read())["metrics"]
         for m in snap:
             if m["name"] == "lux_serve_batch_size":
                 return m
         return None
+
+    def stats(self):
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/stats", timeout=10) as r:
+            return json.loads(r.read())
 
 
 class LocalClient:
@@ -77,6 +89,9 @@ class LocalClient:
             if m["name"] == "lux_serve_batch_size":
                 return m
         return None
+
+    def stats(self):
+        return self.session.stats()
 
 
 def worker(client, mix, nv, stop_at, qps, lat, errs, seed):
@@ -118,7 +133,11 @@ def main() -> int:
                    help="fraction of traffic that is SSSP root queries "
                    "(rest splits between pagerank and components)")
     p.add_argument("--json", action="store_true",
-                   help="emit one machine-readable JSON line at the end")
+                   help="emit one machine-readable serve_bench.v1 JSON "
+                   "line at the end")
+    p.add_argument("--json-out", dest="json_out",
+                   help="also write the serve_bench.v1 report to this "
+                   "path (for tools/slo_check.py)")
     args = p.parse_args()
 
     session = None
@@ -176,15 +195,19 @@ def main() -> int:
           f"({'closed loop' if not args.qps else f'{args.qps} qps/worker'})"
           f"  ->  {total} ok ({total / wall:.1f} req/s), errors: "
           f"{errs or 'none'}")
-    report = {"workers": args.workers, "duration_s": wall,
+    report = {"schema": "serve_bench.v1",
+              "workers": args.workers, "duration_s": wall,
               "requests_ok": total, "rps": total / wall, "errors": errs,
               "apps": {}}
     for app, xs in sorted(lat.items()):
         xs.sort()
-        p50, p99 = percentile(xs, 0.50), percentile(xs, 0.99)
+        p50 = percentile(xs, 0.50)
+        p95 = percentile(xs, 0.95)
+        p99 = percentile(xs, 0.99)
         print(f"  {app:<11} n={len(xs):<6} p50={p50 * 1e3:8.2f} ms   "
-              f"p99={p99 * 1e3:8.2f} ms")
-        report["apps"][app] = {"n": len(xs), "p50_s": p50, "p99_s": p99}
+              f"p95={p95 * 1e3:8.2f} ms   p99={p99 * 1e3:8.2f} ms")
+        report["apps"][app] = {"n": len(xs), "p50_s": p50,
+                               "p95_s": p95, "p99_s": p99}
     hist = client.batch_histogram()
     if hist:
         parts = [
@@ -196,8 +219,28 @@ def main() -> int:
               f"[{', '.join(parts)}]")
         report["batch_size"] = {"count": hist["count"], "mean": mean,
                                 "buckets": hist["buckets"]}
+    # Server-side counters the SLO gate cares about: shed/reject volume
+    # and the sentinel's recompile count (must be 0 post-warmup).
+    try:
+        stats = client.stats()
+    except Exception:
+        stats = {}
+    batcher = stats.get("batcher", {})
+    pool = stats.get("pool", {})
+    report["shed"] = int(batcher.get("deadline_expired", 0))
+    report["rejected"] = int(batcher.get("rejected", 0))
+    report["recompiles"] = int(pool.get("recompiles", 0))
+    report["warmup_compiles"] = int(pool.get("warmup_compiles", 0))
+    print(f"  server      shed={report['shed']} "
+          f"rejected={report['rejected']} "
+          f"recompiles={report['recompiles']}")
     if args.json:
         print(json.dumps(report))
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {args.json_out}")
     if session is not None:
         session.close()
     return 0

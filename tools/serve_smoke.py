@@ -15,6 +15,18 @@ End-to-end acceptance run for the serving subsystem (ISSUE 2):
 5. assert zero engine builds after warmup (pool miss counter flat across
    the query phase — i.e. zero recompiles).
 
+Observability acceptance (ISSUE 6, `make serve-obs` runs this same
+entry point):
+
+6. one request trace-id spans the whole admission->batch->engine->cache
+   chain in the Chrome trace (async "b"/"e" events from obs/spans.py);
+7. the ``/metrics`` Prometheus scrape parses, includes
+   ``lux_xla_compiles_total``, and shows zero serve-phase compiles;
+8. ``/statusz`` reports the rolling SLO windows and queue/cache state;
+9. an injected deadline miss (deadline_s=0) returns HTTP 504 AND drops
+   a valid ``flight.v1`` postmortem in LUX_FLIGHT_DIR that
+   tools/flight_summary.py renders.
+
 Scale with LUX_SMOKE_SCALE (default 10).
 """
 
@@ -22,8 +34,10 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
@@ -47,11 +61,42 @@ def get(base, path):
         return json.loads(r.read())
 
 
+def get_text(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.read().decode()
+
+
 def batch_histogram(base):
-    for m in get(base, "/metrics")["metrics"]:
+    for m in get(base, "/metrics.json")["metrics"]:
         if m["name"] == "lux_serve_batch_size":
             return m
     return None
+
+
+def parse_prometheus(text):
+    """Tiny 0.0.4 parser: {(name, frozen-label-string): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, val = line.rsplit(" ", 1)
+        name, _, labels = series.partition("{")
+        out[(name, labels.rstrip("}"))] = float(val)
+    return out
+
+
+def async_trace_chains(trace_path):
+    """trace-id -> set of span names, from the async b/e events."""
+    chains = {}
+    with open(trace_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("ph") in ("b", "e"):
+                chains.setdefault(ev["id"], set()).add(ev["name"])
+    return chains
 
 
 def main() -> int:
@@ -72,11 +117,22 @@ def main() -> int:
     from lux_tpu.serve import ServeConfig, Session
     from lux_tpu.serve.http import serve_in_thread
 
+    from lux_tpu import obs
+
     g = generate.rmat(scale, 8, seed=1)
     ni = 5
     with tempfile.TemporaryDirectory() as td:
         gpath = os.path.join(td, f"rmat{scale}.lux")
         write_lux(gpath, g)
+
+        # Arm the full observability stack for this run: Chrome trace
+        # stream + flight recorder (the spans flag defaults on).
+        trace_path = os.path.join(td, "trace.jsonl")
+        flight_dir = os.path.join(td, "flight")
+        os.makedirs(flight_dir)
+        os.environ["LUX_TRACE"] = trace_path
+        os.environ["LUX_FLIGHT_DIR"] = flight_dir
+        obs.reconfigure()
 
         # Generous window so even a slow CPU box forms one full batch
         # from the concurrent burst below; real deployments run ~3ms.
@@ -90,8 +146,10 @@ def main() -> int:
 
         health = get(base, "/healthz")
         assert health["ok"] and health["nv"] == g.nv, health
+        assert health["pool_warm"] and health["engines"] > 0, health
         print(f"server up: nv={health['nv']} ne={health['ne']} "
-              f"fingerprint={health['fingerprint']}")
+              f"fingerprint={health['fingerprint']} "
+              f"device={health['device']} engines={health['engines']}")
 
         misses_before = get(base, "/stats")["pool"]["misses"]
         batches_before = (batch_histogram(base) or {"count": 0})["count"]
@@ -163,9 +221,89 @@ def main() -> int:
                   f"p99={stats['latency_s']['p99'] * 1e3:.1f}ms over "
                   f"{stats['latency_s']['count']} requests")
 
+        # -- one trace-id spans admission->batch->engine->cache --------
+        chains = async_trace_chains(trace_path)
+        chain_want = {"serve.admit", "serve.queue_wait", "serve.batch",
+                      "serve.engine"}
+        full = {
+            tid: names for tid, names in chains.items()
+            if chain_want <= names
+            and names & {"serve.cache.put", "serve.cache.get"}
+        }
+        assert full, (
+            f"no single trace-id covers {sorted(chain_want)} + cache; "
+            f"chains: { {t: sorted(n) for t, n in chains.items()} }"
+        )
+        tid, names = next(iter(sorted(full.items())))
+        print(f"spans: trace {tid} covers {sorted(names)} "
+              f"({len(chains)} traces total)")
+
+        # -- Prometheus scrape -----------------------------------------
+        text = get_text(base, "/metrics")
+        samples = parse_prometheus(text)
+        compile_samples = {
+            k: v for k, v in samples.items()
+            if k[0] == "lux_xla_compiles_total"
+        }
+        assert compile_samples, "no lux_xla_compiles_total in /metrics"
+        serve_compiles = sum(
+            v for k, v in compile_samples.items() if 'phase="serve"' in k[1]
+        )
+        assert serve_compiles == 0, (
+            f"serve-phase XLA compiles in scrape: {compile_samples}"
+        )
+        assert any(k[0] == "lux_ir_findings_total" for k in samples), text
+        assert any(k[0] == "lux_span_seconds_bucket" for k in samples), (
+            "span histograms missing from scrape"
+        )
+        print(f"prometheus: {len(samples)} samples, "
+              f"lux_xla_compiles_total serve-phase sum 0")
+
+        # -- /statusz --------------------------------------------------
+        sz = get(base, "/statusz")
+        windows = sz["windows"]
+        assert windows, sz
+        some_window = next(iter(windows.values()))
+        assert any(a.get("count", 0) > 0 for a in some_window.values()), sz
+        assert sz["queue"]["capacity"] > 0
+        assert sz["counters"]["recompiles"] == 0, sz
+        print(f"statusz: windows {sorted(windows)} "
+              f"cache_hit_rate={sz['cache_hit_rate']} "
+              f"queue={sz['queue']['depth']}/{sz['queue']['capacity']}")
+
+        # -- injected deadline miss -> 504 + flight.v1 postmortem ------
+        fresh = next(r for r in range(g.nv) if r not in set(roots))
+        try:
+            post(base, {"app": "sssp", "start": fresh, "deadline_s": 0})
+            raise AssertionError("deadline_s=0 query did not 504")
+        except urllib.error.HTTPError as e:
+            assert e.code == 504, f"expected 504, got {e.code}"
+        dumps = sorted(
+            f for f in os.listdir(flight_dir) if f.endswith(".json")
+        )
+        assert dumps, "deadline shed produced no flight dump"
+        dump_path = os.path.join(flight_dir, dumps[-1])
+        doc = json.loads(open(dump_path).read())
+        assert doc["schema"] == "flight.v1" and             doc["reason"] == "deadline_shed", doc
+        assert doc["traces"] and doc["context"] and doc["flags"], (
+            sorted(doc)
+        )
+        summary = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "flight_summary.py"), dump_path],
+            capture_output=True, text=True,
+        )
+        assert summary.returncode == 0, summary.stderr
+        assert "deadline_shed" in summary.stdout
+        print(f"flight: 504 -> {os.path.basename(dump_path)} "
+              f"({len(doc['traces'])} traces, "
+              f"{len(doc['iterations'])} iteration records) — "
+              "flight_summary renders OK")
+
         server.shutdown()
         session.close()
-    print("serve-smoke PASS")
+    print("serve-smoke PASS (incl. observability: spans, prometheus, "
+          "statusz, flight recorder)")
     return 0
 
 

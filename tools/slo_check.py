@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""SLO regression gate over a serve_bench.v1 report (`make serve-slo`).
+
+Reads the JSON report tools/serve_bench.py wrote with --json-out and
+fails (exit 1) when the serving layer regressed:
+
+- any app's p95 or p99 latency exceeds the baseline by more than the
+  tolerance (default 25% — CI boxes are noisy; tighten with
+  --tolerance for dedicated hardware);
+- the RecompileSentinel counted any post-warmup recompile (always a
+  hard failure: recompiles are a bug, not noise);
+- requests errored, or shed/reject counts grew beyond --max-shed.
+
+Baseline handling follows luxlint's snapshot-or-compare contract: a
+missing baseline file is WRITTEN from the current report and the run
+passes (first run bootstraps the gate; commit the file to pin it).
+
+    python tools/serve_bench.py --json-out /tmp/bench.json
+    python tools/slo_check.py --input /tmp/bench.json \\
+        --baseline bench/serve_slo_baseline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != "serve_bench.v1":
+        raise SystemExit(
+            f"slo_check: {path} is not a serve_bench.v1 report "
+            f"(schema={doc.get('schema')!r})"
+        )
+    return doc
+
+
+def compare(report: dict, base: dict, tolerance: float,
+            max_shed: int) -> list:
+    """Human-readable regression strings (empty == gate passes)."""
+    bad = []
+    if report.get("recompiles", 0) > 0:
+        bad.append(f"post-warmup recompiles: {report['recompiles']} "
+                   "(sentinel must stay at 0)")
+    errs = report.get("errors") or {}
+    # Shed/reject surface both as client-visible error kinds and server
+    # counters; gate on the server's own count.
+    shed = report.get("shed", 0) + report.get("rejected", 0)
+    if shed > max_shed:
+        bad.append(f"shed+rejected = {shed} > --max-shed {max_shed}")
+    hard_errs = {k: v for k, v in errs.items()
+                 if "Deadline" not in k and "QueueFull" not in k
+                 and "HTTPError" not in k}
+    if hard_errs:
+        bad.append(f"hard client errors: {hard_errs}")
+    for app, cur in sorted((report.get("apps") or {}).items()):
+        ref = (base.get("apps") or {}).get(app)
+        if ref is None:
+            continue        # new app: nothing to regress against
+        for q in ("p95_s", "p99_s"):
+            if q not in cur or q not in ref:
+                continue
+            limit = ref[q] * (1.0 + tolerance)
+            if cur[q] > limit and cur[q] - ref[q] > 1e-4:
+                bad.append(
+                    f"{app} {q[:-2]}: {cur[q] * 1e3:.2f} ms > baseline "
+                    f"{ref[q] * 1e3:.2f} ms * (1 + {tolerance:.2f})"
+                )
+    return bad
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--input", required=True,
+                    help="serve_bench.v1 JSON from serve_bench --json-out")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline report path (written if missing)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fractional p95/p99 growth (default 0.25)")
+    ap.add_argument("--max-shed", type=int, default=0, dest="max_shed",
+                    help="allowed shed+rejected requests (default 0)")
+    args = ap.parse_args()
+
+    report = load(args.input)
+    if not os.path.exists(args.baseline):
+        # Recompiles/errors must be clean even on the bootstrap run —
+        # never pin a broken baseline.
+        bad = compare(report, {"apps": {}}, args.tolerance, args.max_shed)
+        if bad:
+            for b in bad:
+                print(f"slo_check: FAIL {b}")
+            return 1
+        parent = os.path.dirname(os.path.abspath(args.baseline))
+        os.makedirs(parent, exist_ok=True)
+        with open(args.baseline, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"slo_check: baseline written: {args.baseline} "
+              f"({len(report.get('apps') or {})} apps) — run again to "
+              "compare")
+        return 0
+
+    base = load(args.baseline)
+    bad = compare(report, base, args.tolerance, args.max_shed)
+    for b in bad:
+        print(f"slo_check: FAIL {b}")
+    if not bad:
+        apps = ", ".join(
+            f"{a} p95 {v.get('p95_s', 0) * 1e3:.2f}ms"
+            for a, v in sorted((report.get("apps") or {}).items())
+        )
+        print(f"slo_check: OK within {args.tolerance:.0%} of "
+              f"{args.baseline} ({apps}; recompiles=0)")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

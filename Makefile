@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke clean
+.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke clean
 
 all: native
 
@@ -21,7 +21,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir plan-check test serve-obs
+verify: lint lint-ir plan-check test serve-obs snapshot-smoke
 
 bench:
 	python bench.py
@@ -40,6 +40,11 @@ serve-obs:
 
 merge-smoke:
 	python tools/merge_smoke.py
+
+# Dynamic-graph acceptance: hot-swap under in-flight traffic, FIFO drain
+# barrier, incremental cache refresh, zero recompiles, one swap trace-id.
+snapshot-smoke:
+	python tools/snapshot_smoke.py
 
 serve-bench:
 	python tools/serve_bench.py --scale 12 --workers 16 --duration 10

@@ -466,6 +466,9 @@ def build_executor(kind: str, graph, program):
     if kind == "push_multi":
         from lux_tpu.engine.push import MultiSourcePushExecutor
         return MultiSourcePushExecutor(graph, program, k=4)
+    if kind == "push_incremental":
+        from lux_tpu.engine.incremental import IncrementalExecutor
+        return IncrementalExecutor(graph, program)
     if kind == "pull_sharded":
         from lux_tpu.engine.pull_sharded import ShardedPullExecutor
         return ShardedPullExecutor(graph, program)

@@ -1,0 +1,304 @@
+"""Incremental recompute: warm-start push fixpoints from a prior snapshot.
+
+Gunrock's frontier-operator framing (arXiv:1501.05387) makes incremental
+recompute a non-event: a fixpoint engine that already advances a frontier
+doesn't care whether the frontier came from ``init_frontier`` or from the
+set of vertices an edit batch touched. This module computes that touched
+set on the host and hands the existing push executors a warm
+:class:`~lux_tpu.engine.push.PushState` — same shapes, same jitted
+executables, zero new compiles on a warmed pool.
+
+Invalidation (the only subtle part) is per monotone-combiner program
+(SSSP min, components max):
+
+- *Seeds*: a removed edge ``u -> v`` invalidates ``v`` iff it supported
+  v's old value — ``relax(old[u], w) == old[v]`` and ``old[v]`` is not
+  v's init value (init values need no support).
+- *Propagation*: a BFS over the NEW graph's out-edges resets ``b`` when a
+  reset vertex ``a`` supported ``old[b]`` through a surviving edge, using
+  the ORIGINAL old values for every support test.
+- Reset vertices restart from their init values; everything else keeps
+  its old fixpoint value.
+
+Why this is sufficient (min-combiner; max is symmetric with the
+inequalities flipped): every non-reset vertex retains a support chain of
+surviving, non-reset vertices realizing its old value — had any chain
+link been removed or reset, the seed rule or the BFS would have reset it
+too (``old`` is an exact old-graph fixpoint, so ``old[v] ==
+relax(old[p], w)`` holds along the chain and the support test fires).
+Hence every warm value is achievable in the new graph — pointwise >= the
+true new fixpoint but attainable — and monotone push iteration from the
+warm frontier (reset vertices + their new-graph in-neighbors + insert
+sources, i.e. every vertex whose push could first lower a neighbor)
+converges to exactly the full-recompute fixpoint. Parity is therefore
+*bitwise* for integral apps; tests/test_incremental.py asserts it
+against from-scratch runs and host oracles.
+
+PageRank is not a monotone push program; :func:`incremental_pagerank`
+warm-starts the pull iteration from the previous ranks (re-divided by
+the new out-degrees) and runs to an L-inf tolerance instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.push import (MultiSourcePushExecutor, PushExecutor,
+                                 PushState)
+from lux_tpu.graph.graph import Graph
+
+
+def _relax_np(program, vals: np.ndarray, w) -> np.ndarray:
+    """Host-side view of the program's relax (one tiny jnp eval)."""
+    return np.asarray(program.relax(
+        jnp.asarray(vals), None if w is None else jnp.asarray(w)
+    ))
+
+
+def _gather_slices(ptr: np.ndarray, ids: np.ndarray):
+    """Flat indices of ``[ptr[i], ptr[i+1])`` for every i in ``ids``,
+    plus ``np.repeat(ids, counts)`` — the vectorized adjacency expansion
+    used by the host BFS (no per-vertex Python loop)."""
+    starts = ptr[ids]
+    counts = (ptr[ids + 1] - starts).astype(np.int64)
+    total = int(counts.sum())
+    if not total:
+        e = np.zeros(0, dtype=np.int64)
+        return e, e
+    offs = np.arange(total, dtype=np.int64) - np.repeat(
+        np.cumsum(counts) - counts, counts
+    )
+    return np.repeat(starts.astype(np.int64), counts) + offs, np.repeat(
+        ids, counts
+    )
+
+
+def invalidate(program, graph: Graph, old_values: np.ndarray,
+               init_values: np.ndarray, rem_src, rem_dst,
+               rem_w) -> np.ndarray:
+    """Boolean mask of vertices whose old values lose support under the
+    edit batch (see module docstring for the exact rule)."""
+    nv = graph.nv
+    reset = np.zeros(nv, dtype=bool)
+    rem_src = np.asarray(rem_src, dtype=np.int64)
+    rem_dst = np.asarray(rem_dst, dtype=np.int64)
+    if rem_src.size:
+        cand = _relax_np(program, old_values[rem_src], rem_w)
+        hit = (cand == old_values[rem_dst]) & (
+            old_values[rem_dst] != init_values[rem_dst]
+        )
+        frontier = np.unique(rem_dst[hit])
+    else:
+        frontier = np.zeros(0, dtype=np.int64)
+    reset[frontier] = True
+    csr = graph.csr()
+    while frontier.size:
+        idx, a = _gather_slices(csr.row_ptr, frontier)
+        if not idx.size:
+            break
+        b = csr.col_dst[idx].astype(np.int64)
+        w = csr.weights[idx] if csr.weights is not None else None
+        cand = _relax_np(program, old_values[a], w)
+        hit = (cand == old_values[b]) & (
+            old_values[b] != init_values[b]
+        ) & ~reset[b]
+        frontier = np.unique(b[hit])
+        reset[frontier] = True
+    return reset
+
+
+def _warm_column(program, graph: Graph, old_values: np.ndarray,
+                 removed, inserted, **init_kw):
+    """(values, frontier, n_reset) for one root/lane, host-side."""
+    old_values = np.asarray(old_values)
+    init_values = np.asarray(program.init_values(graph, **init_kw))
+    if old_values.shape != init_values.shape:
+        raise ValueError(
+            f"old values shape {old_values.shape} != graph shape "
+            f"{init_values.shape}; snapshots never change nv"
+        )
+    rem_src, rem_dst, rem_w = removed if removed is not None else ((), (), None)
+    reset = invalidate(program, graph, old_values, init_values,
+                       rem_src, rem_dst, rem_w)
+    vals = np.where(reset, init_values, old_values).astype(old_values.dtype)
+    fr = np.zeros(graph.nv, dtype=bool)
+    ridx = np.nonzero(reset)[0]
+    fr[ridx] = True
+    if ridx.size:
+        # In-neighbors of the reset region in the NEW graph: the vertices
+        # whose surviving values refill it.
+        idx, _ = _gather_slices(graph.row_ptr, ridx)
+        fr[graph.col_src[idx]] = True
+    if inserted is not None and len(inserted[0]):
+        fr[np.asarray(inserted[0], dtype=np.int64)] = True
+    return vals, fr, int(ridx.size)
+
+
+class IncrementalExecutor:
+    """Warm-started push fixpoints over an edit batch.
+
+    Wraps a (possibly pool-warmed) :class:`PushExecutor` and optionally a
+    :class:`MultiSourcePushExecutor` for the NEW graph; ``run``/
+    ``run_multi`` take the previous snapshot's fixpoint values plus the
+    ``removed``/``inserted`` edge arrays and drive the wrapped engines
+    from the warm state — identical shapes, so a warmed executable never
+    recompiles.
+
+    ``removed`` is ``(src, dst, w|None)`` of the base edges actually
+    removed (see :func:`lux_tpu.graph.delta.removed_edges`); ``inserted``
+    is ``(src, dst[, w])`` of the edges added.
+    """
+
+    def __init__(self, graph: Graph, program, push: Optional[PushExecutor] = None,
+                 multi: Optional[MultiSourcePushExecutor] = None,
+                 k: Optional[int] = None, device=None):
+        self.graph = graph
+        self.program = program
+        self.device = device
+        self.push = push if push is not None else PushExecutor(
+            graph, program, device=device
+        )
+        self.multi = multi
+        if self.multi is None and k is not None:
+            self.multi = MultiSourcePushExecutor(graph, program, k,
+                                                 device=device)
+
+    # -- single source ---------------------------------------------------
+
+    def warm_state(self, old_values, removed=None, inserted=None, **init_kw):
+        """Device-resident warm ``PushState`` + an info dict
+        (``reset``/``frontier``/``touched_frac``)."""
+        vals, fr, n_reset = _warm_column(
+            self.program, self.graph, old_values, removed, inserted,
+            **init_kw
+        )
+        state = PushState(
+            jax.device_put(jnp.asarray(vals), self.device),
+            jax.device_put(jnp.asarray(fr), self.device),
+        )
+        info = {
+            "reset": n_reset,
+            "frontier": int(fr.sum()),
+            "touched_frac": float(fr.sum() / max(self.graph.nv, 1)),
+        }
+        return state, info
+
+    def run(self, old_values, removed=None, inserted=None,
+            max_iters: Optional[int] = None, chunk: int = 16,
+            recorder=None, **init_kw):
+        """Fixpoint from the warm state; returns ``(state, iters, info)``
+        with ``state.values`` bitwise-equal to a from-scratch run."""
+        state, info = self.warm_state(old_values, removed, inserted,
+                                      **init_kw)
+        state, iters = self.push.run(max_iters=max_iters, state=state,
+                                     chunk=chunk, recorder=recorder)
+        return state, iters, info
+
+    # -- multi source (dense (nv, K) sweep) ------------------------------
+
+    def run_multi(self, starts, old_columns, removed=None, inserted=None,
+                  max_iters: Optional[int] = None, chunk: int = 16,
+                  recorder=None):
+        """Warm the K-lane sweep: lane j restarts root ``starts[j]`` from
+        ``old_columns[j]``. Fewer than k roots are right-padded exactly
+        like ``init_state`` so the warmed executable is reused."""
+        if self.multi is None:
+            raise ValueError("no MultiSourcePushExecutor attached")
+        starts = list(starts)
+        cols = list(old_columns)
+        if len(starts) != len(cols):
+            raise ValueError("one old-value column per root required")
+        if not 1 <= len(starts) <= self.multi.k:
+            raise ValueError(
+                f"need 1..{self.multi.k} roots, got {len(starts)}"
+            )
+        pad = self.multi.k - len(starts)
+        starts = starts + [starts[-1]] * pad
+        cols = cols + [cols[-1]] * pad
+        vals_cols, fr_cols, resets = [], [], 0
+        for s, old in zip(starts, cols):
+            v, f, r = _warm_column(self.program, self.graph, old, removed,
+                                   inserted, start=s)
+            vals_cols.append(v)
+            fr_cols.append(f)
+            resets += r
+        state = PushState(
+            jax.device_put(jnp.asarray(np.stack(vals_cols, axis=1)),
+                           self.device),
+            jax.device_put(jnp.asarray(np.stack(fr_cols, axis=1)),
+                           self.device),
+        )
+        fsum = int(sum(int(f.sum()) for f in fr_cols))
+        info = {
+            "reset": resets,
+            "frontier": fsum,
+            "touched_frac": float(
+                fsum / max(self.graph.nv * self.multi.k, 1)
+            ),
+        }
+        state, iters = self.multi.run(starts, max_iters=max_iters,
+                                      chunk=chunk, recorder=recorder,
+                                      state=state)
+        return state, iters, info
+
+    # -- pool / luxlint-IR hooks -----------------------------------------
+
+    def warmup(self, chunk: int = 16, **init_kw):
+        self.push.warmup(chunk=chunk, **init_kw)
+
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook: the wrapped push step entered through a warm
+        state built from an empty edit batch — same executable signature
+        the incremental path runs, audited as its own target kind."""
+        init = np.asarray(self.program.init_values(self.graph, **init_kw))
+        state, _ = self.warm_state(init, **init_kw)
+        return {
+            "kind": "push_incremental",
+            "fn": self.push._step,
+            "args": (state, self.push._dg),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
+
+
+def incremental_pagerank(executor, old_stored: np.ndarray,
+                         old_out_degrees: np.ndarray, ni: int,
+                         tol: float = 1e-7, chunk: int = 8):
+    """Warm-start PageRank on ``executor``'s (new) graph from the
+    previous snapshot's stored ranks.
+
+    The pull engine stores ranks pre-divided by out-degree; degrees
+    change under edits, so the warm vector is the previous *true* ranks
+    re-divided by the NEW degrees. Iterates in ``chunk`` steps until the
+    stored vector moves less than ``tol`` (L-inf) or ``ni`` iterations —
+    parity with a from-scratch run is tolerance-based, matching the
+    app's float semantics (the serving path keeps full ``ni``-from-init
+    recomputes for its cache; see serve/session.py).
+
+    Returns ``(stored_values, iters_run)``.
+    """
+    from lux_tpu.models.pagerank import true_ranks
+
+    g = executor.graph
+    true = np.asarray(true_ranks(np.asarray(old_stored),
+                                 np.asarray(old_out_degrees)))
+    new_deg = g.out_degrees
+    warm = np.where(new_deg == 0, true,
+                    true / np.maximum(new_deg, 1)).astype(np.float32)
+    vals = warm
+    iters = 0
+    while iters < ni:
+        step = min(chunk, ni - iters)
+        # Compare on host copies: the pull step donates its input buffer,
+        # so the device array handed to run() is dead afterwards.
+        prev = np.asarray(vals)
+        vals = np.asarray(executor.run(step, vals=jnp.asarray(prev)))
+        iters += step
+        if float(np.max(np.abs(vals - prev))) < tol:
+            break
+    return vals, iters

@@ -803,12 +803,20 @@ class MultiSourcePushExecutor:
         max_iters: Optional[int] = None,
         chunk: int = 16,
         recorder=None,
+        state: Optional[PushState] = None,
     ):
         """Run all roots in ``starts`` to their shared fixpoint; returns
         (final_state, iterations_run). Column j of ``state.values`` is
         root ``starts[j]``'s result — bit-identical to a single-source
-        ``PushExecutor`` run from that root (tests/test_serve.py)."""
-        state = self.init_state(starts)
+        ``PushExecutor`` run from that root (tests/test_serve.py).
+
+        ``state`` warm-starts the sweep from a caller-built (nv, k)
+        state instead of ``init_state(starts)`` — the incremental
+        executor seeds per-lane values/frontiers from a previous
+        snapshot's fixpoint. Shapes must match ``init_state``'s so the
+        warmed executable is reused."""
+        if state is None:
+            state = self.init_state(starts)
         rec = recorder if recorder is not None else recorder_for(
             "push_multi", self.graph, self.program)
         rec.start()

@@ -1,10 +1,16 @@
 from lux_tpu.graph.graph import Graph
 from lux_tpu.graph.format import (detect_layout, read_lux, read_lux_mmap, write_lux)
 from lux_tpu.graph.partition import edge_balanced_bounds, PartitionInfo
+from lux_tpu.graph.delta import DeltaGraph, EdgeEdits
+from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
 from lux_tpu.graph import generate
 
 __all__ = [
     "Graph",
+    "DeltaGraph",
+    "EdgeEdits",
+    "Snapshot",
+    "SnapshotStore",
     "read_lux",
     "read_lux_mmap",
     "write_lux",

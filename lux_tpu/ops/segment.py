@@ -363,3 +363,67 @@ def segment_sum_by_rowptr(data: jnp.ndarray, row_ptr: jnp.ndarray) -> jnp.ndarra
     else:
         g = z[row_ptr]
     return g[1:] - g[:-1]
+
+
+def csc_counting_merge(
+    row_ptr: np.ndarray,
+    col_src: np.ndarray,
+    weights,
+    keep: np.ndarray,
+    ins_dst: np.ndarray,
+    ins_src: np.ndarray,
+    ins_w,
+    nv: int,
+):
+    """Merge a kept subset of a CSC edge list with sorted inserts, host-side.
+
+    One counting-sort pass instead of a full ``argsort`` over the merged
+    edge list: per-destination survivor counts come from a prefix sum over
+    ``keep``, insert counts from a ``bincount``, and every edge's final
+    slot is a closed-form offset — kept edges keep their base-relative
+    order within each destination segment, inserts (pre-sorted by
+    ``(dst, src)``) land after them. O(ne + ni + nv) with no comparison
+    sort, deterministic by construction.
+
+    ``keep`` is a boolean mask over the base edges; ``ins_dst``/``ins_src``
+    must be sorted by ``(dst, src)``. Returns
+    ``(new_row_ptr int64 (nv+1,), new_col_src, new_weights|None)``.
+    """
+    ne = int(col_src.shape[0])
+    ni = int(ins_dst.shape[0])
+    if weights is None and ins_w is not None:
+        raise ValueError("insert weights given for an unweighted base")
+    if weights is not None and ni and ins_w is None:
+        raise ValueError("weighted base requires insert weights")
+
+    ex = np.zeros(ne + 1, dtype=np.int64)
+    np.cumsum(keep, out=ex[1:])
+    kept_per = ex[row_ptr[1:]] - ex[row_ptr[:-1]]
+    ins_per = np.bincount(ins_dst, minlength=nv).astype(np.int64)
+
+    new_rp = np.zeros(nv + 1, dtype=np.int64)
+    np.cumsum(kept_per + ins_per, out=new_rp[1:])
+    total = int(new_rp[-1])
+
+    new_src = np.empty(total, dtype=col_src.dtype)
+    has_w = weights is not None
+    new_w = np.empty(total, dtype=weights.dtype) if has_w else None
+
+    kept_e = np.nonzero(keep)[0]
+    if kept_e.size:
+        # Destination of each base edge, recovered from row_ptr without
+        # materialising the full col_dst: searchsorted on the kept ids.
+        dst_of = np.searchsorted(row_ptr, kept_e, side="right").astype(np.int64) - 1
+        pos = new_rp[dst_of] + ex[kept_e] - ex[row_ptr[dst_of]]
+        new_src[pos] = col_src[kept_e]
+        if has_w:
+            new_w[pos] = weights[kept_e]
+    if ni:
+        first = np.searchsorted(ins_dst, ins_dst)  # first index of each dst run
+        rank = np.arange(ni, dtype=np.int64) - first
+        d = ins_dst.astype(np.int64)
+        pos_i = new_rp[d] + kept_per[d] + rank
+        new_src[pos_i] = ins_src.astype(col_src.dtype)
+        if has_w:
+            new_w[pos_i] = ins_w
+    return new_rp, new_src, new_w

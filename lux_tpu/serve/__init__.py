@@ -18,6 +18,7 @@ from lux_tpu.serve.errors import (
     DeadlineExceededError,
     QueueFullError,
     ServeError,
+    SnapshotSwapError,
 )
 from lux_tpu.serve.pool import EnginePool
 from lux_tpu.serve.session import ServeConfig, Session
@@ -33,4 +34,5 @@ __all__ = [
     "QueueFullError",
     "DeadlineExceededError",
     "BadQueryError",
+    "SnapshotSwapError",
 ]

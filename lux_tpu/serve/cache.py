@@ -29,6 +29,9 @@ class ResultCache:
         self._hits = metrics.counter("lux_serve_cache_hits_total")
         self._misses = metrics.counter("lux_serve_cache_misses_total")
         self._evictions = metrics.counter("lux_serve_cache_evictions_total")
+        self._invalidations = metrics.counter(
+            "lux_serve_cache_invalidations_total"
+        )
 
     def get(self, key: Hashable) -> Optional[Any]:
         with self._lock:
@@ -51,6 +54,29 @@ class ResultCache:
                     self._d.popitem(last=False)
                     self._evictions.inc()
 
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._d.keys())
+
+    def evict_fingerprint(self, fingerprint: str) -> int:
+        """Drop every entry keyed by ``fingerprint`` (hot-swap invalidation).
+
+        Serving keys lead with the graph fingerprint, so entries for a
+        retired snapshot are exactly the tuple keys whose first element
+        matches. Without this they linger until LRU pressure, pinning the
+        dead snapshot's arrays and inflating the /statusz hit-rate with
+        unreachable entries."""
+        with self._lock:
+            victims = [
+                k for k in self._d
+                if isinstance(k, tuple) and k and k[0] == fingerprint
+            ]
+            for k in victims:
+                del self._d[k]
+            if victims:
+                self._invalidations.inc(len(victims))
+        return len(victims)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._d)
@@ -62,4 +88,5 @@ class ResultCache:
             "hits": int(self._hits.value),
             "misses": int(self._misses.value),
             "evictions": int(self._evictions.value),
+            "invalidations": int(self._invalidations.value),
         }

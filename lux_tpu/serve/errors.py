@@ -35,3 +35,11 @@ class BadQueryError(ServeError):
     (HTTP 400)."""
 
     http_status = 400
+
+
+class SnapshotSwapError(ServeError):
+    """A snapshot hot-swap could not complete (engine warmup timed out or
+    failed). The previous version keeps serving — the swap is abandoned,
+    not half-applied; the client may retry (HTTP 503)."""
+
+    http_status = 503

@@ -17,6 +17,17 @@ Endpoints:
 - ``GET /statusz`` — rolling 1-min/5-min SLO windows (p50/p95/p99 per
   app), queue depth, cache hit rate, batch-width histogram, shed and
   recompile counters (JSON; windows set by ``LUX_STATUSZ_WINDOWS``).
+- ``GET /snapshot`` — the serving snapshot version, fingerprint, delta
+  ratio, and the store's version history.
+- ``POST /snapshot`` — admin edit endpoint: body
+  ``{"insert": [[u, v], ...], "delete": [[u, v], ...]}`` (weighted
+  graphs take ``[u, v, w]`` inserts) applies the batch and hot-swaps
+  serving onto version N+1 (serve/session.py ``apply_edits``); the old
+  version drains and keeps answering throughout. 503 when warmup of the
+  new version times out (the old version keeps serving).
+
+Every JSON response carries ``X-Lux-Snapshot: <serving version>`` so
+clients can observe a hot-swap from response headers alone.
 
 Every ``POST /query`` runs under a root request span (obs/spans.py):
 the response carries the trace-id in ``X-Lux-Trace``, and the same id
@@ -104,6 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if trace_id:
             self.send_header("X-Lux-Trace", trace_id)
+        if self.session is not None:
+            self.send_header("X-Lux-Snapshot", str(self.session.version))
         self.end_headers()
         self.wfile.write(body)
 
@@ -144,10 +157,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_text(200, metrics.render_prometheus())
         elif self.path == "/metrics.json":
             self._reply(200, {"metrics": metrics.snapshot()})
+        elif self.path == "/snapshot":
+            self._reply(200, s.snapshot_info())
         else:
             self._reply(404, {"error": f"no such endpoint {self.path}"})
 
     def do_POST(self):
+        if self.path == "/snapshot":
+            self._post_snapshot()
+            return
         if self.path != "/query":
             self._reply(404, {"error": f"no such endpoint {self.path}"})
             return
@@ -180,6 +198,38 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": f"bad JSON: {e}",
                                   "kind": "BadQueryError"}, trace_id=tid)
             except Exception as e:   # engine bug: surface, keep serving
+                self._reply(500, {"error": str(e),
+                                  "kind": type(e).__name__}, trace_id=tid)
+
+    def _post_snapshot(self):
+        from lux_tpu.graph.delta import EdgeEdits
+
+        # Its own root span: one trace-id covers the whole swap —
+        # snapshot.apply, the background warm (it adopts this id), the
+        # incremental refresh, and the drain barrier.
+        with spans.span("http.request", path=self.path) as tid:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise BadQueryError("body must be a JSON object")
+                try:
+                    edits = EdgeEdits.from_lists(
+                        insert=body.get("insert", ()),
+                        delete=body.get("delete", ()),
+                    )
+                except (TypeError, ValueError, IndexError) as e:
+                    raise BadQueryError(f"bad edit batch: {e}")
+                summary = self.session.apply_edits(edits)
+                self._reply(200, summary, trace_id=tid)
+            except ServeError as e:
+                self._reply(e.http_status, {
+                    "error": str(e), "kind": type(e).__name__,
+                }, trace_id=tid)
+            except json.JSONDecodeError as e:
+                self._reply(400, {"error": f"bad JSON: {e}",
+                                  "kind": "BadQueryError"}, trace_id=tid)
+            except Exception as e:   # swap bug: surface, keep serving
                 self._reply(500, {"error": str(e),
                                   "kind": type(e).__name__}, trace_id=tid)
 

@@ -36,6 +36,7 @@ class EnginePool:
         # Created eagerly so a clean pool still exports 0 — the serve
         # dashboards alert on this going nonzero, not on its absence.
         self._ir_findings = metrics.counter("lux_ir_findings_total")
+        self._retired = metrics.counter("lux_serve_pool_retired_total")
         self.sentinel = RecompileSentinel(scope)
 
     def get(self, key: Hashable, factory: Callable[[], object]):
@@ -81,6 +82,20 @@ class EnginePool:
             self._ir_findings.inc()
             print(f"EnginePool: {f.format()}")
 
+    def retire(self, predicate: Callable[[Hashable], bool]) -> int:
+        """Drop every engine whose key satisfies ``predicate`` (hot-swap:
+        the session retires all engines keyed by the outgoing snapshot's
+        fingerprint once in-flight queries drain). Dropped executors are
+        released to GC — device buffers for a dead snapshot's graph are
+        the largest thing a swap frees."""
+        with self._lock:
+            victims = [k for k in self._engines if predicate(k)]
+            for k in victims:
+                del self._engines[k]
+            if victims:
+                self._retired.inc(len(victims))
+        return len(victims)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._engines)
@@ -90,6 +105,7 @@ class EnginePool:
             "engines": len(self),
             "hits": int(self._hits.value),
             "misses": int(self._misses.value),
+            "retired": int(self._retired.value),
             "warmup_compiles": self.sentinel.compiles(),
             "recompiles": self.sentinel.recompiles(),
             "ir_findings": int(self._ir_findings.value),

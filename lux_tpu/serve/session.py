@@ -17,24 +17,37 @@ Query routing:
 
 Every result cache key embeds the hardened graph fingerprint
 (utils/checkpoint.fingerprint), so answers can never leak across graphs.
+
+Dynamic graphs (ISSUE 7): the session serves one
+:class:`~lux_tpu.graph.snapshot.SnapshotStore` version at a time.
+``apply_edits`` stacks an edit batch into version N+1, warms its engines
+on a background thread (the old version keeps serving the whole time),
+optionally refreshes cached fixpoints incrementally from version N's
+values, then atomically flips the serving pointer and rides a barrier
+request through the FIFO batcher — by the time the barrier executes,
+every in-flight version-N query has been answered, so the barrier can
+retire N's engines and evict its cache entries without failing anyone.
 """
 
 from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Union
 
 import numpy as np
 
 from lux_tpu.graph.graph import Graph
+from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
 from lux_tpu.obs import flight, metrics, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
 from lux_tpu.serve.cache import ResultCache
-from lux_tpu.serve.errors import BadQueryError
+from lux_tpu.serve.errors import (BadQueryError, QueueFullError,
+                                  SnapshotSwapError)
 from lux_tpu.serve.pool import EnginePool
-from lux_tpu.utils import checkpoint
+from lux_tpu.utils import flags
 from lux_tpu.utils.logging import get_logger
 
 
@@ -82,8 +95,9 @@ class Session:
 
             self.graph_path = graph
             graph = native_io.read_lux(graph)
-        self.graph = graph
-        self.fingerprint = checkpoint.fingerprint_hex(graph)
+        self.store = SnapshotStore(graph)
+        self._serving = self.store.current()
+        self._swap_lock = threading.Lock()
         self.pool = EnginePool()
         self.cache = ResultCache(self.config.cache_capacity)
         self.batcher = MicroBatcher(
@@ -102,51 +116,72 @@ class Session:
         if warm:
             self.warmup()
 
+    # -- serving snapshot ------------------------------------------------
+
+    @property
+    def graph(self) -> Graph:
+        """The currently served graph (version ``self.version``)."""
+        return self._serving.graph
+
+    @property
+    def fingerprint(self) -> str:
+        return self._serving.fingerprint
+
+    @property
+    def version(self) -> int:
+        return self._serving.version
+
     # -- engines ---------------------------------------------------------
 
-    def _engine_key(self, kind: str, extra=()) -> tuple:
-        return (kind, self.fingerprint) + tuple(extra)
+    def _engine_key(self, kind: str, snap: Snapshot, extra=()) -> tuple:
+        return (kind, snap.fingerprint) + tuple(extra)
 
-    def _sssp_single(self):
+    def _sssp_single(self, snap: Optional[Snapshot] = None):
         from lux_tpu.engine.push import PushExecutor
         from lux_tpu.models.sssp import SSSP
 
+        snap = snap or self._serving
         return self.pool.get(
-            self._engine_key("push", ("sssp", 1)),
-            lambda: PushExecutor(self.graph, SSSP()),
+            self._engine_key("push", snap, ("sssp", 1)),
+            lambda: PushExecutor(snap.graph, SSSP()),
         )
 
-    def _sssp_multi(self):
+    def _sssp_multi(self, snap: Optional[Snapshot] = None):
         from lux_tpu.engine.push import MultiSourcePushExecutor
         from lux_tpu.models.sssp import SSSP
 
+        snap = snap or self._serving
         k = self.config.max_batch
         return self.pool.get(
-            self._engine_key("push_multi", ("sssp", k)),
-            lambda: MultiSourcePushExecutor(self.graph, SSSP(), k=k),
+            self._engine_key("push_multi", snap, ("sssp", k)),
+            lambda: MultiSourcePushExecutor(snap.graph, SSSP(), k=k),
         )
 
-    def _components_engine(self):
+    def _components_engine(self, snap: Optional[Snapshot] = None):
         from lux_tpu.engine.push import PushExecutor
         from lux_tpu.models.components import ConnectedComponents
 
+        snap = snap or self._serving
         return self.pool.get(
-            self._engine_key("push", ("components", 1)),
-            lambda: PushExecutor(self.graph, ConnectedComponents()),
+            self._engine_key("push", snap, ("components", 1)),
+            lambda: PushExecutor(snap.graph, ConnectedComponents()),
         )
 
-    def _pagerank_engine(self):
+    def _pagerank_engine(self, snap: Optional[Snapshot] = None):
         from lux_tpu.models.cli import make_executor
         from lux_tpu.models.pagerank import PageRank
+
+        snap = snap or self._serving
 
         def build():
             from lux_tpu.engine.pull import PullExecutor
 
-            if self.graph_path is None:
+            if self.graph_path is None or snap.version > 0:
                 # The tiled fast path persists its hybrid plan next to
-                # the graph file; an in-memory graph has none, so serve
-                # from the flat pull engine.
-                return PullExecutor(self.graph, PageRank())
+                # the graph file; an in-memory graph has none, and an
+                # edited snapshot no longer matches the on-disk plan —
+                # both serve from the flat pull engine.
+                return PullExecutor(snap.graph, PageRank())
             import argparse
 
             # Reuse the CLI's engine-selection policy (tiled when
@@ -156,25 +191,28 @@ class Session:
                 levels="8/2", tile_mb=8192, plan_cache=None,
                 file=self.graph_path,
             )
-            return make_executor(self.graph, PageRank(), args, self.log)
+            return make_executor(snap.graph, PageRank(), args, self.log)
 
         return self.pool.get(
-            self._engine_key("pull", ("pagerank",)), build
+            self._engine_key("pull", snap, ("pagerank",)), build
         )
 
-    def warmup(self):
-        """Build + compile every served engine before traffic arrives.
-        After this, the pool miss counter is the recompile count: the
-        smoke test asserts it stays flat across the query phase."""
-        with spans.span("serve.warmup"):
+    def warmup(self, snap: Optional[Snapshot] = None):
+        """Build + compile every served engine before traffic arrives
+        (for ``snap``, default the serving snapshot — the hot-swap warms
+        the incoming version through this same path). After this, the
+        pool miss counter is the recompile count: the smoke test asserts
+        it stays flat across the query phase."""
+        snap = snap or self._serving
+        with spans.span("serve.warmup", version=snap.version):
             with _timed(self.log, "warmup sssp single"):
-                self._sssp_single()
+                self._sssp_single(snap)
             with _timed(self.log, "warmup sssp multi"):
-                self._sssp_multi()
+                self._sssp_multi(snap)
             with _timed(self.log, "warmup components"):
-                self._components_engine()
+                self._components_engine(snap)
             with _timed(self.log, "warmup pagerank"):
-                self._pagerank_engine()
+                self._pagerank_engine(snap)
 
     # -- query front door ------------------------------------------------
 
@@ -215,12 +253,17 @@ class Session:
         if spans.current_trace_id() is None and spans.enabled():
             tid, finish = spans.open_trace()
             token = spans.activate(tid)
+        # One read of the serving pointer per request: everything below
+        # (cache keys, batch keys, engine lookups) binds to this snapshot,
+        # so a hot-swap mid-request can never mix versions.
+        snap = self._serving
         try:
             if app == "sssp":
-                fut = self._submit_sssp(params, deadline)
+                fut = self._submit_sssp(params, deadline, snap)
             elif app == "components":
                 fut = self._submit_cached_fixpoint(
-                    app, ("components",), self._run_components, deadline
+                    app, ("components",),
+                    lambda: self._run_components(snap), deadline, snap,
                 )
             else:
                 ni = int(params.get("ni", self.config.pagerank_iters))
@@ -230,7 +273,7 @@ class Session:
                     )
                 fut = self._submit_cached_fixpoint(
                     app, ("pagerank", ni),
-                    lambda: self._run_pagerank(ni), deadline,
+                    lambda: self._run_pagerank(ni, snap), deadline, snap,
                 )
         except BaseException:
             if token is not None:
@@ -255,30 +298,34 @@ class Session:
         """Synchronous ``submit``; blocks for the result."""
         return self.submit(app, **params).result(timeout=timeout)
 
-    def _submit_sssp(self, params: dict, deadline) -> Future:
+    def _submit_sssp(self, params: dict, deadline, snap: Snapshot) -> Future:
         try:
             start = int(params["start"])
         except (KeyError, TypeError, ValueError):
             raise BadQueryError("sssp needs an integer 'start' root")
-        if not 0 <= start < self.graph.nv:
+        nv = snap.graph.nv
+        if not 0 <= start < nv:
             raise BadQueryError(
-                f"sssp start {start} out of range [0, {self.graph.nv})"
+                f"sssp start {start} out of range [0, {nv})"
             )
-        key = (self.fingerprint, "sssp", start)
+        key = (snap.fingerprint, "sssp", start)
         hit = self.cache.get(key)
         if hit is not None:
             fut: Future = Future()
             fut.set_result(hit)
             return fut
+        # The batch key embeds the snapshot fingerprint: queries straddling
+        # a hot-swap can never share one dense sweep across two graphs.
         req = Request(
-            app="sssp", payload=start,
-            batch_key=("sssp", self.fingerprint, self.config.max_batch),
+            app="sssp", payload=(snap, start),
+            batch_key=("sssp", snap.fingerprint, self.config.max_batch),
             deadline=deadline,
         )
         return self.batcher.submit(req)
 
-    def _submit_cached_fixpoint(self, app, key_tail, run, deadline) -> Future:
-        key = (self.fingerprint,) + tuple(key_tail)
+    def _submit_cached_fixpoint(self, app, key_tail, run, deadline,
+                                snap: Snapshot) -> Future:
+        key = (snap.fingerprint,) + tuple(key_tail)
         hit = self.cache.get(key)
         if hit is not None:
             fut: Future = Future()
@@ -309,6 +356,13 @@ class Session:
         if batch[0].app == "sssp":
             self._execute_sssp_batch(batch)
             return
+        if batch[0].app == "_drain":
+            # Hot-swap barrier: FIFO ordering means every request admitted
+            # before the swap flipped the serving pointer has already been
+            # executed by the time this runs — retiring the old version's
+            # state here can fail no in-flight query.
+            batch[0].future.set_result(batch[0].payload())
+            return
         # Unbatchable request (singleton list): cached fixpoint runner.
         (key, run) = batch[0].payload
         hit = self.cache.get(key)   # raced submits may have filled it
@@ -318,19 +372,20 @@ class Session:
         batch[0].future.set_result(hit)
 
     def _execute_sssp_batch(self, batch: List[Request]):
-        roots = [r.payload for r in batch]
+        snap = batch[0].payload[0]   # batch_key pins one snapshot per batch
+        roots = [r.payload[1] for r in batch]
         if len(batch) == 1:
-            key = self._engine_key("push", ("sssp", 1))
-            ex = self._sssp_single()
+            key = self._engine_key("push", snap, ("sssp", 1))
+            ex = self._sssp_single(snap)
             with self._watched(key), spans.span(
                     "serve.engine", app="sssp", engine="push", lanes=1):
                 state, iters = ex.run(start=roots[0])
                 results = [np.asarray(state.values)]
         else:
             key = self._engine_key(
-                "push_multi", ("sssp", self.config.max_batch)
+                "push_multi", snap, ("sssp", self.config.max_batch)
             )
-            ex = self._sssp_multi()
+            ex = self._sssp_multi(snap)
             with self._watched(key), spans.span(
                     "serve.engine", app="sssp", engine="push_multi",
                     lanes=len(roots)):
@@ -340,33 +395,294 @@ class Session:
                 ]
         for r, root, vals in zip(batch, roots, results):
             out = {"values": vals, "iters": int(iters), "start": root}
-            self.cache.put((self.fingerprint, "sssp", root), out)
+            self.cache.put((snap.fingerprint, "sssp", root), out)
             r.future.set_result(out)
 
-    def _run_components(self) -> dict:
-        ex = self._components_engine()
-        with self._watched(self._engine_key("push", ("components", 1))), \
+    def _run_components(self, snap: Snapshot) -> dict:
+        ex = self._components_engine(snap)
+        with self._watched(
+                self._engine_key("push", snap, ("components", 1))), \
                 spans.span("serve.engine", app="components",
                            engine="push"):
             state, iters = ex.run()
         return {"values": np.asarray(state.values), "iters": int(iters)}
 
-    def _run_pagerank(self, ni: int) -> dict:
+    def _run_pagerank(self, ni: int, snap: Snapshot) -> dict:
         from lux_tpu.models.cli import final_values
 
-        ex = self._pagerank_engine()
-        with self._watched(self._engine_key("pull", ("pagerank",))), \
+        ex = self._pagerank_engine(snap)
+        with self._watched(self._engine_key("pull", snap, ("pagerank",))), \
                 spans.span("serve.engine", app="pagerank", engine="pull",
                            iters=ni):
             vals = ex.run(ni)
         return {"values": final_values(ex, vals), "iters": ni}
 
+    # -- snapshot hot-swap -----------------------------------------------
+
+    def apply_edits(self, edits, warm_timeout: Optional[float] = None) -> dict:
+        """Apply an edit batch and hot-swap serving onto version N+1.
+
+        Sequence (one swap at a time; version N serves throughout):
+
+        1. ``store.apply(edits)`` mints version N+1 (compaction, if the
+           delta crossed LUX_DELTA_COMPACT_RATIO, proceeds in its own
+           background thread — readers are unaffected either way);
+        2. N+1's engines build + compile on a background warm thread,
+           bounded by LUX_SNAPSHOT_WARM_TIMEOUT — on timeout or error the
+           swap aborts with :class:`SnapshotSwapError` and N keeps
+           serving;
+        3. with LUX_INCREMENTAL, cached components/SSSP fixpoints are
+           refreshed by warm-started incremental runs and stored under
+           N+1's fingerprint *before* the flip (PageRank entries are
+           evicted, not refreshed: its served semantics are
+           ni-iterations-from-init, which a warm start cannot reproduce
+           mid-trajectory — misses recompute on demand);
+        4. the serving pointer flips (atomic assignment; every request
+           reads it once at admission);
+        5. a barrier request rides the FIFO batcher behind all remaining
+           version-N work, then retires N's engines and evicts its cache
+           entries — zero failed in-flight queries by construction.
+
+        Returns a summary dict (versions, fingerprints, eviction counts,
+        incremental-refresh counts, timings).
+        """
+        from lux_tpu.graph.delta import EdgeEdits
+
+        if self._closed:
+            raise BadQueryError("session is closed")
+        if not isinstance(edits, EdgeEdits):
+            raise BadQueryError("apply_edits takes an EdgeEdits batch")
+        if warm_timeout is None:
+            warm_timeout = flags.get_float("LUX_SNAPSHOT_WARM_TIMEOUT")
+        with self._swap_lock:
+            t_swap0 = spans.clock()
+            old = self._serving
+            finish = None
+            token = None
+            if spans.current_trace_id() is None and spans.enabled():
+                tid, finish = spans.open_trace()
+                token = spans.activate(tid)
+            try:
+                with spans.span("serve.snapshot_swap",
+                                old_version=old.version):
+                    summary = self._swap(old, edits, warm_timeout, t_swap0)
+            finally:
+                if token is not None:
+                    spans.deactivate(token)
+                if finish is not None:
+                    finish()
+            return summary
+
+    def _swap(self, old: Snapshot, edits, warm_timeout: float,
+              t_swap0: float) -> dict:
+        try:
+            snap = self.store.apply(edits)
+        except ValueError as e:
+            raise BadQueryError(str(e)) from None
+
+        # Warm version N+1's engines off-thread so a stuck compile can't
+        # wedge the session; the sentinel sees the builds as expected
+        # warmup (pool.get wraps them in expect(key)).
+        warm_err: List[BaseException] = []
+        tid = spans.current_trace_id()
+
+        def _warm():
+            with spans.adopt(tid):
+                with spans.span("serve.snapshot_warm",
+                                version=snap.version):
+                    try:
+                        self.warmup(snap)
+                    except BaseException as e:   # surfaced to the caller
+                        warm_err.append(e)
+
+        t_warm0 = spans.clock()
+        warm_thread = threading.Thread(
+            target=_warm, name=f"lux-snapshot-warm-v{snap.version}",
+            daemon=True,
+        )
+        warm_thread.start()
+        warm_thread.join(warm_timeout)
+        warm_s = spans.clock() - t_warm0
+        if warm_thread.is_alive() or warm_err:
+            metrics.counter("lux_snapshot_aborts_total").inc()
+            why = (f"warmup timed out after {warm_timeout:.1f}s"
+                   if warm_thread.is_alive()
+                   else f"warmup failed: {warm_err[0]!r}")
+            self.log.error("snapshot swap v%d -> v%d aborted: %s",
+                           old.version, snap.version, why)
+            raise SnapshotSwapError(
+                f"snapshot v{snap.version} not swapped in ({why}); "
+                f"v{old.version} still serving"
+            )
+
+        refreshed = None
+        if flags.get_bool("LUX_INCREMENTAL"):
+            refreshed = self._incremental_refresh(old, snap, edits)
+
+        # The atomic flip: requests admitted after this line bind to N+1.
+        self._serving = snap
+        metrics.gauge("lux_snapshot_version").set(float(snap.version))
+        metrics.counter("lux_snapshot_applies_total").inc()
+
+        drained = self._drain_behind(old)
+        swap_s = spans.clock() - t_swap0
+        metrics.histogram("lux_snapshot_swap_seconds").observe(swap_s)
+        self.log.info(
+            "snapshot swap v%d -> v%d in %.2fs (warm %.2fs, "
+            "evicted %d cache entries, retired %d engines)",
+            old.version, snap.version, swap_s, warm_s,
+            drained["evicted"], drained["retired"],
+        )
+        return {
+            "old_version": old.version,
+            "version": snap.version,
+            "old_fingerprint": old.fingerprint,
+            "fingerprint": snap.fingerprint,
+            "nv": snap.graph.nv,
+            "ne": snap.graph.ne,
+            "delta_ratio": round(snap.ratio, 6),
+            "warm_s": warm_s,
+            "swap_s": swap_s,
+            "refreshed": refreshed,
+            **drained,
+        }
+
+    def _drain_behind(self, old: Snapshot) -> dict:
+        """Ride a barrier through the FIFO batcher behind every remaining
+        version-``old`` request, then retire that version's state."""
+        old_fp = old.fingerprint
+
+        def _retire() -> dict:
+            evicted = self.cache.evict_fingerprint(old_fp)
+            retired = self.pool.retire(
+                lambda k: isinstance(k, tuple) and len(k) > 1
+                and k[1] == old_fp
+            )
+            # _served_keys is batcher-thread-only state and the barrier
+            # runs on the batcher thread: prune without a lock.
+            self._served_keys = {
+                k for k in self._served_keys
+                if not (isinstance(k, tuple) and len(k) > 1
+                        and k[1] == old_fp)
+            }
+            return {"evicted": evicted, "retired": retired}
+
+        while True:
+            try:
+                fut = self.batcher.submit(Request(
+                    app="_drain", payload=_retire, batch_key=None,
+                ))
+                break
+            except QueueFullError:
+                # The queue is full of real traffic; the barrier must
+                # still land (it frees the old snapshot), so back off
+                # briefly and retry — admission is FIFO either way.
+                time.sleep(0.01)
+        return fut.result()
+
+    def _incremental_refresh(self, old: Snapshot, snap: Snapshot,
+                             edits) -> dict:
+        """Warm-start cached fixpoints from version N's values and store
+        them under N+1's fingerprint before the flip.
+
+        Components and cached SSSP roots refresh bitwise (monotone push
+        programs; engine/incremental.py proves the warm start exact).
+        Cached SSSP roots ride the dense (nv, K) multi-source sweep in
+        K-wide batches — the same warmed executable the serving path
+        uses, so the refresh compiles nothing.
+        """
+        from lux_tpu.engine.incremental import IncrementalExecutor
+        from lux_tpu.graph.delta import removed_edges
+        from lux_tpu.models.components import ConnectedComponents
+        from lux_tpu.models.sssp import SSSP
+
+        removed = removed_edges(old.graph, edits.del_src, edits.del_dst)
+        inserted = (edits.ins_src, edits.ins_dst)
+        out = {"components": 0, "sssp": 0, "touched_frac": None}
+
+        with spans.span("serve.incremental_refresh", version=snap.version):
+            cc_hit = self.cache.get((old.fingerprint, "components"))
+            if cc_hit is not None:
+                ex = self._components_engine(snap)
+                inc = IncrementalExecutor(
+                    snap.graph, ConnectedComponents(), push=ex
+                )
+                key = self._engine_key("push", snap, ("components", 1))
+                with self.pool.sentinel.expect(("incremental",) + key), \
+                        spans.span("serve.incremental", app="components"):
+                    state, iters, info = inc.run(
+                        cc_hit["values"], removed=removed,
+                        inserted=inserted,
+                    )
+                self.cache.put(
+                    (snap.fingerprint, "components"),
+                    {"values": np.asarray(state.values),
+                     "iters": int(iters), "incremental": True},
+                )
+                out["components"] = 1
+                out["touched_frac"] = info["touched_frac"]
+
+            roots = [
+                k[2] for k in self.cache.keys()
+                if isinstance(k, tuple) and len(k) == 3
+                and k[0] == old.fingerprint and k[1] == "sssp"
+            ]
+            if roots:
+                k_w = self.config.max_batch
+                multi = self._sssp_multi(snap)
+                inc = IncrementalExecutor(snap.graph, SSSP(), multi=multi)
+                mkey = self._engine_key("push_multi", snap, ("sssp", k_w))
+                for i in range(0, len(roots), k_w):
+                    lane_roots, olds = [], []
+                    for r in roots[i:i + k_w]:
+                        hit = self.cache.get((old.fingerprint, "sssp", r))
+                        if hit is not None:   # LRU may race entries away
+                            lane_roots.append(r)
+                            olds.append(hit["values"])
+                    if not lane_roots:
+                        continue
+                    with self.pool.sentinel.expect(
+                            ("incremental",) + mkey), \
+                            spans.span("serve.incremental", app="sssp",
+                                       lanes=len(lane_roots)):
+                        state, iters, info = inc.run_multi(
+                            lane_roots, olds, removed=removed,
+                            inserted=inserted,
+                        )
+                    for j, r in enumerate(lane_roots):
+                        self.cache.put(
+                            (snap.fingerprint, "sssp", r),
+                            {"values": multi.values_for(state, j),
+                             "iters": int(iters), "start": r,
+                             "incremental": True},
+                        )
+                    out["sssp"] += len(lane_roots)
+                    out["touched_frac"] = info["touched_frac"]
+        return out
+
+    def snapshot_info(self) -> dict:
+        """The /snapshot GET payload: serving version + store history."""
+        snap = self._serving
+        return {
+            "version": snap.version,
+            "fingerprint": snap.fingerprint,
+            "nv": snap.graph.nv,
+            "ne": snap.graph.ne,
+            "delta_ratio": round(snap.ratio, 6),
+            "compacted": snap.compacted,
+            "history": self.store.history(),
+        }
+
     # -- introspection / lifecycle ---------------------------------------
 
     def stats(self) -> dict:
+        snap = self._serving
         s = {
-            "graph": {"nv": self.graph.nv, "ne": self.graph.ne,
-                      "fingerprint": self.fingerprint},
+            "graph": {"nv": snap.graph.nv, "ne": snap.graph.ne,
+                      "fingerprint": snap.fingerprint},
+            "snapshot": {"version": snap.version,
+                         "delta_ratio": round(snap.ratio, 6),
+                         "compacted": snap.compacted},
             "pool": self.pool.stats(),
             "cache": self.cache.stats(),
             "batcher": self.batcher.stats(),
@@ -390,6 +706,8 @@ class Session:
         probes = c["hits"] + c["misses"]
         return {
             "windows": self.slo.snapshot(),
+            "snapshot": {"version": self.version,
+                         "fingerprint": self.fingerprint},
             "queue": {"depth": b["queue_depth"],
                       "capacity": b["queue_capacity"]},
             "cache_hit_rate": (c["hits"] / probes) if probes else None,
@@ -410,6 +728,7 @@ class Session:
         return {
             "graph": {"nv": self.graph.nv, "ne": self.graph.ne,
                       "fingerprint": self.fingerprint},
+            "snapshot": {"version": self.version},
             "pool": self.pool.stats(),
             "cache": self.cache.stats(),
             "batcher": self.batcher.stats(),
@@ -422,6 +741,7 @@ class Session:
             flight.remove_context(self._flight_name)
             self.batcher.close()
             self.pool.close()
+            self.store.drain_compactions()
 
     def __enter__(self):
         return self

@@ -219,6 +219,20 @@ define("LUX_PLANCK_INFLATION", 8.0,
        "(rows per level / ceil(reals/128)) a saved plan may carry",
        kind="float")
 
+# Dynamic graphs (graph/snapshot.py, engine/incremental.py,
+# serve/session.py)
+define("LUX_DELTA_COMPACT_RATIO", 0.05,
+       "background-compact a snapshot's delta once pending edits exceed "
+       "this fraction of the base edge count", kind="float")
+define("LUX_SNAPSHOT_WARM_TIMEOUT", 120.0,
+       "seconds to wait for the next snapshot's engines to warm before "
+       "aborting the hot-swap (the old version keeps serving)",
+       kind="float")
+define("LUX_INCREMENTAL", True,
+       "warm-start components/cached-SSSP fixpoints from the previous "
+       "snapshot's values during a hot-swap instead of recomputing on "
+       "demand (0 = evict only)", kind="bool")
+
 # Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
 define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")
 define("LUX_SMOKE_ITERS", 8, "obs_smoke PageRank iterations", kind="int")

@@ -1,0 +1,234 @@
+"""Delta graphs + snapshot store: merge parity, edit semantics,
+compaction round-trips, fingerprint stability."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import (DeltaGraph, EdgeEdits, Graph, SnapshotStore,
+                           generate)
+from lux_tpu.graph.delta import _edge_keys, removed_edges
+from lux_tpu.ops.segment import csc_counting_merge
+from lux_tpu.utils import checkpoint
+
+
+def _random_edits(g, rng, n_ins, n_del, weighted=False):
+    ins = [
+        (int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+        + ((int(rng.integers(1, 10)),) if weighted else ())
+        for _ in range(n_ins)
+    ]
+    dels = []
+    if n_del:
+        eidx = rng.choice(g.ne, size=min(n_del, g.ne), replace=False)
+        dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    return EdgeEdits.from_lists(insert=ins, delete=dels), ins, dels
+
+
+def _naive_merge(g, ins, dels):
+    """Reference comparator: mask deleted pairs, append sorted inserts,
+    rebuild with Graph.from_edges (stable sort by dst)."""
+    if dels:
+        dk = np.unique(_edge_keys(
+            np.array([d[0] for d in dels]), np.array([d[1] for d in dels]),
+            g.nv))
+        keep = ~np.isin(_edge_keys(g.col_src, g.col_dst, g.nv), dk)
+    else:
+        keep = np.ones(g.ne, dtype=bool)
+    i_s = np.array([i[0] for i in ins], dtype=np.int64)
+    i_d = np.array([i[1] for i in ins], dtype=np.int64)
+    order = np.argsort(_edge_keys(i_s, i_d, g.nv), kind="stable")
+    w = None
+    if g.weighted:
+        i_w = np.array([i[2] for i in ins], dtype=g.weights.dtype)
+        w = np.concatenate([g.weights[keep], i_w[order]])
+    return Graph.from_edges(
+        np.concatenate([g.col_src[keep].astype(np.int64), i_s[order]]),
+        np.concatenate([g.col_dst[keep].astype(np.int64), i_d[order]]),
+        g.nv, weights=w,
+    )
+
+
+SEEDS = [
+    ("rmat", lambda s: generate.rmat(7, 8, seed=s)),
+    ("small_world", lambda s: generate.small_world(256, 6, 0.1, seed=s)),
+]
+
+
+@pytest.mark.parametrize("name,make", SEEDS, ids=[s[0] for s in SEEDS])
+@pytest.mark.parametrize("kind", ["inserts", "deletes", "mixed", "empty"])
+def test_merged_matches_naive_rebuild(name, make, kind):
+    """Property: merged() is bitwise-equal to a from-scratch
+    Graph.from_edges over the surviving edge list, for random insert-only,
+    delete-only, mixed, and empty batches on both synthetic families."""
+    rng = np.random.default_rng(hash((name, kind)) % 2**31)
+    g = make(3)
+    n = max(1, g.ne // 50)
+    n_ins = n if kind in ("inserts", "mixed") else 0
+    n_del = n if kind in ("deletes", "mixed") else 0
+    ed, ins, dels = _random_edits(g, rng, n_ins, n_del)
+    m = DeltaGraph.fresh(g).stack(ed).merged()
+    ref = _naive_merge(g, ins, dels)
+    assert m.nv == ref.nv and m.ne == ref.ne
+    np.testing.assert_array_equal(m.row_ptr, ref.row_ptr)
+    np.testing.assert_array_equal(m.col_src, ref.col_src)
+
+
+def test_merged_weighted_parity():
+    g = generate.gnp(200, 1500, seed=11, weighted=True)
+    rng = np.random.default_rng(11)
+    ed, ins, dels = _random_edits(g, rng, 20, 20, weighted=True)
+    m = DeltaGraph.fresh(g).stack(ed).merged()
+    ref = _naive_merge(g, ins, dels)
+    np.testing.assert_array_equal(m.row_ptr, ref.row_ptr)
+    np.testing.assert_array_equal(m.col_src, ref.col_src)
+    np.testing.assert_array_equal(m.weights, ref.weights)
+
+
+def test_empty_delta_returns_base_identity():
+    """No pending edits -> merged() IS the base object (fingerprint and
+    any cached executor state stay valid)."""
+    g = generate.rmat(7, 8, seed=1)
+    assert DeltaGraph.fresh(g).merged() is g
+
+
+def test_delete_removes_all_parallel_copies():
+    g = Graph.from_edges(np.array([0, 0, 1]), np.array([1, 1, 2]), 3)
+    assert g.ne == 3
+    m = DeltaGraph.fresh(g).stack(
+        EdgeEdits.from_lists(delete=[(0, 1)])
+    ).merged()
+    assert m.ne == 1
+    np.testing.assert_array_equal(m.col_src, [1])
+
+
+def test_delete_then_reinsert_single_batch_keeps_edge():
+    """Within one batch deletes apply before inserts: delete+insert of
+    the same pair leaves exactly one copy."""
+    g = Graph.from_edges(np.array([0, 1]), np.array([1, 2]), 3)
+    m = DeltaGraph.fresh(g).stack(
+        EdgeEdits.from_lists(insert=[(0, 1)], delete=[(0, 1)])
+    ).merged()
+    assert m.ne == 2
+    keys = _edge_keys(m.col_src, m.col_dst, m.nv)
+    assert (keys == 0 + 1 * 3).sum() == 1
+
+
+def test_stacked_batches_delete_pending_insert():
+    """A later batch's delete removes an earlier batch's pending insert."""
+    g = Graph.from_edges(np.array([0]), np.array([1]), 4)
+    dg = DeltaGraph.fresh(g)
+    dg = dg.stack(EdgeEdits.from_lists(insert=[(2, 3)]))
+    dg = dg.stack(EdgeEdits.from_lists(delete=[(2, 3)]))
+    assert dg.merged().ne == 1
+
+
+def test_stack_is_value_semantics():
+    """stack() never mutates the receiver: a snapshot holding the old
+    delta still merges to the old graph."""
+    g = generate.gnp(100, 600, seed=7)
+    d0 = DeltaGraph.fresh(g)
+    d1 = d0.stack(EdgeEdits.from_lists(insert=[(1, 2)]))
+    assert d0.merged() is g
+    assert d1.merged().ne == g.ne + 1
+
+
+def test_edits_validate_vertex_range():
+    g = generate.gnp(50, 200, seed=3)
+    with pytest.raises(ValueError, match="vertex ids outside"):
+        DeltaGraph.fresh(g).stack(
+            EdgeEdits.from_lists(insert=[(0, g.nv)])
+        )
+
+
+def test_weighted_base_requires_insert_weights():
+    g = generate.gnp(50, 200, seed=3, weighted=True)
+    with pytest.raises(ValueError, match="requires insert weights"):
+        DeltaGraph.fresh(g).stack(EdgeEdits.from_lists(insert=[(0, 1)]))
+    with pytest.raises(ValueError, match="unweighted base"):
+        DeltaGraph.fresh(generate.gnp(50, 200, seed=3)).stack(
+            EdgeEdits.from_lists(insert=[(0, 1, 5)])
+        )
+
+
+def test_removed_edges_reports_actual_copies():
+    g = Graph.from_edges(np.array([0, 0, 1]), np.array([1, 1, 2]), 3)
+    rs, rd, _ = removed_edges(g, np.array([0]), np.array([1]))
+    assert list(rs) == [0, 0] and list(rd) == [1, 1]
+    rs, rd, _ = removed_edges(g, np.array([2]), np.array([0]))  # absent
+    assert rs.size == 0
+
+
+def test_csc_counting_merge_weight_mismatch_raises():
+    g = generate.gnp(20, 60, seed=1, weighted=True)
+    keep = np.ones(g.ne, dtype=bool)
+    ins = np.array([1], dtype=np.int64)
+    with pytest.raises(ValueError):
+        csc_counting_merge(g.row_ptr, g.col_src, g.weights, keep,
+                           ins, ins, None, g.nv)
+
+
+# -- snapshot store -------------------------------------------------------
+
+
+def test_snapshot_store_versions_and_fingerprints():
+    g = generate.rmat(7, 8, seed=5)
+    st = SnapshotStore(g)
+    s0 = st.current()
+    assert s0.version == 0 and s0.graph is g
+    s1 = st.apply(EdgeEdits.from_lists(insert=[(1, 2), (3, 4)]))
+    assert st.current() is s1 and s1.version == 1
+    assert s1.fingerprint != s0.fingerprint
+    assert s1.graph.ne == g.ne + 2
+    assert st.get(0) is s0
+    with pytest.raises(KeyError):
+        st.get(7)
+    hist = st.history()
+    assert [h["version"] for h in hist] == [0, 1]
+    st.drain_compactions()
+
+
+def test_compaction_preserves_fingerprint_and_graph():
+    """Compaction re-anchors the delta on its merged CSC: the fingerprint
+    (and the graph object readers hold) must not change — the round-trip
+    is a bitwise no-op."""
+    g = generate.rmat(7, 8, seed=6)
+    st = SnapshotStore(g)
+    s1 = st.apply(EdgeEdits.from_lists(
+        insert=[(0, 1), (2, 3)], delete=[(int(g.col_src[0]),
+                                          int(g.col_dst[0]))]))
+    g1 = s1.graph
+    fp1 = s1.fingerprint
+    s1.compact()
+    assert s1.compacted
+    assert s1.graph is g1
+    assert s1.fingerprint == fp1
+    assert s1.delta.delta_edges == 0
+    # further edits stack on the compacted anchor identically
+    s2_graph = s1.delta.stack(
+        EdgeEdits.from_lists(insert=[(5, 6)])).merged()
+    assert s2_graph.ne == g1.ne + 1
+    st.drain_compactions()
+
+
+def test_background_compaction_triggers_past_ratio(monkeypatch):
+    monkeypatch.setenv("LUX_DELTA_COMPACT_RATIO", "0.0")
+    g = generate.gnp(100, 500, seed=9)
+    st = SnapshotStore(g)
+    fired = threading.Event()
+    s1 = st.apply(EdgeEdits.from_lists(insert=[(1, 2)]),
+                  on_compact=lambda s: fired.set())
+    assert fired.wait(10.0), "background compaction never ran"
+    st.drain_compactions()
+    assert s1.compacted
+    assert s1.fingerprint == checkpoint.fingerprint_hex(s1.graph)
+
+
+def test_no_compaction_below_ratio(monkeypatch):
+    monkeypatch.setenv("LUX_DELTA_COMPACT_RATIO", "0.5")
+    g = generate.gnp(100, 500, seed=9)
+    st = SnapshotStore(g)
+    s1 = st.apply(EdgeEdits.from_lists(insert=[(1, 2)]))
+    st.drain_compactions()
+    assert not s1.compacted and s1.delta.delta_edges == 1

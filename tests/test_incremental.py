@@ -1,0 +1,262 @@
+"""Incremental recompute: warm-started fixpoints over edit batches must
+be bitwise-equal (SSSP/CC) or tolerance-equal (PageRank) to from-scratch
+runs, and measurably cheaper in iterations."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine.incremental import (IncrementalExecutor, invalidate,
+                                        incremental_pagerank)
+from lux_tpu.engine.push import MultiSourcePushExecutor, PushExecutor
+from lux_tpu.engine.pull import PullExecutor
+from lux_tpu.graph import DeltaGraph, EdgeEdits, generate
+from lux_tpu.graph.delta import removed_edges
+from lux_tpu.models.components import ConnectedComponents, \
+    reference_components
+from lux_tpu.models.pagerank import PageRank, reference_pagerank, true_ranks
+from lux_tpu.models.sssp import SSSP, reference_sssp
+
+
+def _edit(g, seed, n_ins, n_del):
+    """A random edit batch plus the (removed, inserted) arrays the
+    incremental path consumes and the merged new graph."""
+    rng = np.random.default_rng(seed)
+    ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+           for _ in range(n_ins)]
+    dels = []
+    if n_del:
+        eidx = rng.choice(g.ne, size=min(n_del, g.ne), replace=False)
+        dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    ed = EdgeEdits.from_lists(insert=ins, delete=dels)
+    new_g = DeltaGraph.fresh(g).stack(ed).merged()
+    removed = removed_edges(g, ed.del_src, ed.del_dst)
+    inserted = (ed.ins_src, ed.ins_dst)
+    return new_g, removed, inserted
+
+
+@pytest.fixture(scope="module")
+def base():
+    return generate.rmat(8, 8, seed=21)
+
+
+@pytest.mark.parametrize("seed,n_ins,n_del", [
+    (1, 20, 0),    # insert-only
+    (2, 0, 20),    # delete-only
+    (3, 15, 15),   # mixed
+    (4, 0, 0),     # empty batch: warm state already at fixpoint
+])
+def test_sssp_bitwise_parity(base, seed, n_ins, n_del):
+    g = base
+    start = 3
+    old_state, full_old = PushExecutor(g, SSSP()).run(start=start)
+    old = np.asarray(old_state.values)
+    new_g, removed, inserted = _edit(g, seed, n_ins, n_del)
+
+    state, inc_iters, info = IncrementalExecutor(new_g, SSSP()).run(
+        old, removed=removed, inserted=inserted, start=start
+    )
+    got = np.asarray(state.values)
+    np.testing.assert_array_equal(got, reference_sssp(new_g, start))
+    full_state, full_iters = PushExecutor(new_g, SSSP()).run(start=start)
+    np.testing.assert_array_equal(got, np.asarray(full_state.values))
+    assert info["touched_frac"] <= 1.0
+    if n_ins == n_del == 0:
+        # No edits -> nothing reset, frontier empty, converges instantly.
+        assert info["reset"] == 0 and inc_iters <= 1
+
+
+@pytest.mark.parametrize("seed,n_ins,n_del", [(5, 25, 0), (6, 0, 25),
+                                              (7, 12, 12)])
+def test_components_bitwise_parity(base, seed, n_ins, n_del):
+    """Directed label propagation: incremental must match the from-scratch
+    push fixpoint bitwise (the union-find oracle only applies to
+    symmetric graphs — see test_components_symmetric_oracle)."""
+    g = base
+    old_state, _ = PushExecutor(g, ConnectedComponents()).run()
+    old = np.asarray(old_state.values)
+    new_g, removed, inserted = _edit(g, seed, n_ins, n_del)
+
+    state, _, _ = IncrementalExecutor(new_g, ConnectedComponents()).run(
+        old, removed=removed, inserted=inserted
+    )
+    got = np.asarray(state.values)
+    full_state, _ = PushExecutor(new_g, ConnectedComponents()).run()
+    np.testing.assert_array_equal(got, np.asarray(full_state.values))
+
+
+def test_components_symmetric_oracle():
+    """On a symmetric graph with symmetrized edits the incremental
+    fixpoint matches the union-find oracle bitwise."""
+    g = generate.undirected(generate.gnp(200, 350, seed=205))
+    old_state, _ = PushExecutor(g, ConnectedComponents()).run()
+    old = np.asarray(old_state.values)
+    rng = np.random.default_rng(205)
+    pairs = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+             for _ in range(8)]
+    ins = [p for (u, v) in pairs for p in ((u, v), (v, u))]
+    eidx = rng.choice(g.ne, size=8, replace=False)
+    dels = [p for e in eidx
+            for p in ((int(g.col_src[e]), int(g.col_dst[e])),
+                      (int(g.col_dst[e]), int(g.col_src[e])))]
+    ed = EdgeEdits.from_lists(insert=ins, delete=dels)
+    new_g = DeltaGraph.fresh(g).stack(ed).merged()
+    state, _, _ = IncrementalExecutor(new_g, ConnectedComponents()).run(
+        old, removed=removed_edges(g, ed.del_src, ed.del_dst),
+        inserted=(ed.ins_src, ed.ins_dst)
+    )
+    np.testing.assert_array_equal(np.asarray(state.values),
+                                  reference_components(new_g))
+
+
+def test_sssp_weighted_parity():
+    g = generate.gnp(400, 3000, seed=31, weighted=True)
+    rng = np.random.default_rng(31)
+    ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)),
+            int(rng.integers(1, 9))) for _ in range(15)]
+    eidx = rng.choice(g.ne, size=15, replace=False)
+    dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    ed = EdgeEdits.from_lists(insert=ins, delete=dels)
+    new_g = DeltaGraph.fresh(g).stack(ed).merged()
+    old_state, _ = PushExecutor(g, SSSP()).run(start=0)
+    state, _, _ = IncrementalExecutor(new_g, SSSP()).run(
+        np.asarray(old_state.values),
+        removed=removed_edges(g, ed.del_src, ed.del_dst),
+        inserted=(ed.ins_src, ed.ins_dst), start=0,
+    )
+    full_state, _ = PushExecutor(new_g, SSSP()).run(start=0)
+    np.testing.assert_array_equal(np.asarray(state.values),
+                                  np.asarray(full_state.values))
+
+
+def test_multi_source_warm_lanes(base):
+    """K warm lanes through one dense sweep: each lane bitwise-equal to
+    the single-source oracle on the new graph."""
+    g = base
+    roots = [0, 9, 44, 200]
+    cols = []
+    for r in roots:
+        st, _ = PushExecutor(g, SSSP()).run(start=r)
+        cols.append(np.asarray(st.values))
+    new_g, removed, inserted = _edit(g, 8, 10, 10)
+    inc = IncrementalExecutor(new_g, SSSP(), k=len(roots))
+    state, _, info = inc.run_multi(roots, cols, removed=removed,
+                                   inserted=inserted)
+    for j, r in enumerate(roots):
+        np.testing.assert_array_equal(
+            inc.multi.values_for(state, j), reference_sssp(new_g, r)
+        )
+    assert 0.0 <= info["touched_frac"] <= 1.0
+
+
+def test_multi_source_pads_short_batches(base):
+    g = base
+    st, _ = PushExecutor(g, SSSP()).run(start=7)
+    old = np.asarray(st.values)
+    new_g, removed, inserted = _edit(g, 9, 5, 5)
+    inc = IncrementalExecutor(new_g, SSSP(), k=4)
+    state, _, _ = inc.run_multi([7], [old], removed=removed,
+                                inserted=inserted)
+    want = reference_sssp(new_g, 7)
+    for j in range(4):
+        np.testing.assert_array_equal(inc.multi.values_for(state, j), want)
+    with pytest.raises(ValueError):
+        inc.run_multi([1, 2], [old])   # one column per root
+    with pytest.raises(ValueError, match="no MultiSourcePushExecutor"):
+        IncrementalExecutor(new_g, SSSP()).run_multi([1], [old])
+
+
+def test_shape_mismatch_rejected(base):
+    g = base
+    with pytest.raises(ValueError, match="snapshots never change nv"):
+        IncrementalExecutor(g, SSSP()).run(
+            np.zeros(g.nv - 1, dtype=np.uint32), start=0
+        )
+
+
+def test_invalidate_only_resets_unsupported(base):
+    """Removing a non-supporting edge resets nothing; removing the sole
+    support of a vertex resets it (and, transitively, its dependents)."""
+    g = generate.gnp(300, 1200, seed=41)
+    st, _ = PushExecutor(g, SSSP()).run(start=0)
+    old = np.asarray(st.values)
+    init = np.asarray(SSSP().init_values(g, start=0))
+    # An edge u->v that does NOT support v: old[u]+1 != old[v].
+    prog = SSSP()
+    for e in range(g.ne):
+        u, v = int(g.col_src[e]), int(g.col_dst[e])
+        if old[u] + 1 != old[v]:
+            reset = invalidate(prog, g, old, init, [u], [v], None)
+            assert not reset.any()
+            break
+    # The sole support: pick a v at distance d whose only in-edge from
+    # distance d-1 is unique.
+    reset_any = invalidate(prog, g, old, init,
+                           g.col_src.astype(np.int64),
+                           g.col_dst.astype(np.int64),
+                           g.weights)
+    # Deleting every edge resets every reachable non-root vertex.
+    reachable = (old != init) | (np.arange(g.nv) == 0)
+    assert (reset_any == ((old != init) & reachable)).all()
+
+
+def test_incremental_fewer_iterations(base):
+    """The measurable-speedup contract: a 1% edit batch converges in
+    strictly fewer push iterations than the from-scratch run."""
+    g = base
+    start = 3
+    old_state, _ = PushExecutor(g, SSSP()).run(start=start)
+    old = np.asarray(old_state.values)
+    n = max(1, g.ne // 100)
+    new_g, removed, inserted = _edit(g, 10, n, n)
+    _, full_iters = PushExecutor(new_g, SSSP()).run(start=start, chunk=1)
+    _, inc_iters, info = IncrementalExecutor(new_g, SSSP()).run(
+        old, removed=removed, inserted=inserted, start=start, chunk=1
+    )
+    assert inc_iters < full_iters
+    assert info["touched_frac"] < 1.0
+
+
+def test_parity_after_compaction_round_trip(base):
+    """Warm-start off a compacted snapshot's graph: compaction re-anchors
+    the CSC but must not perturb incremental results."""
+    g = base
+    st, _ = PushExecutor(g, SSSP()).run(start=3)
+    old = np.asarray(st.values)
+    rng = np.random.default_rng(50)
+    ed = EdgeEdits.from_lists(
+        insert=[(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+                for _ in range(10)])
+    dg = DeltaGraph.fresh(g).stack(ed)
+    compacted = DeltaGraph.fresh(dg.merged())   # the compaction re-anchor
+    state, _, _ = IncrementalExecutor(compacted.merged(), SSSP()).run(
+        old, inserted=(ed.ins_src, ed.ins_dst), start=3
+    )
+    np.testing.assert_array_equal(np.asarray(state.values),
+                                  reference_sssp(dg.merged(), 3))
+
+
+def test_trace_step_shapes(base):
+    """The luxlint-IR hook returns the wrapped push step with a warm
+    state of the audited shapes."""
+    spec = IncrementalExecutor(base, SSSP()).trace_step(start=0)
+    assert spec["kind"] == "push_incremental"
+    state = spec["args"][0]
+    assert state.values.shape == (base.nv,)
+
+
+def test_incremental_pagerank_tolerance(base):
+    g = base
+    ni = 50
+    old_stored = np.asarray(PullExecutor(g, PageRank()).run(ni))
+    new_g, _, _ = _edit(g, 12, 10, 10)
+    stored, iters = incremental_pagerank(
+        PullExecutor(new_g, PageRank()), old_stored, g.out_degrees,
+        ni, tol=1e-7,
+    )
+    # reference_pagerank returns the same stored (pre-divided) convention;
+    # compare true rank mass so the tolerance is degree-independent.
+    want = np.asarray(true_ranks(reference_pagerank(new_g, ni),
+                                 new_g.out_degrees))
+    got = np.asarray(true_ranks(stored, new_g.out_degrees))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    assert iters < ni   # warm start converges early

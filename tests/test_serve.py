@@ -202,7 +202,7 @@ def test_engine_pool_builds_once():
     bb = pool.get(k, lambda: builds.append(1) or object())
     assert a is bb and builds == [1]
     st = pool.stats()
-    assert st == {"engines": 1, "hits": 1, "misses": 1,
+    assert st == {"engines": 1, "hits": 1, "misses": 1, "retired": 0,
                   "warmup_compiles": 0, "recompiles": 0,
                   "ir_findings": 0}
     pool.close()

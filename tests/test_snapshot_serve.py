@@ -1,0 +1,264 @@
+"""Serving hot-swap: apply_edits drains version N while N+1 warms,
+invalidates caches by fingerprint, refreshes fixpoints incrementally,
+and never fails an in-flight query or recompiles a warmed engine."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import DeltaGraph, EdgeEdits, generate
+from lux_tpu.models.sssp import reference_sssp
+from lux_tpu.obs import metrics
+from lux_tpu.serve import (BadQueryError, ServeConfig, Session,
+                           SnapshotSwapError)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("window_s", 0.01)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("pagerank_iters", 4)
+    return ServeConfig(**kw)
+
+
+def _edits(g, seed, n):
+    rng = np.random.default_rng(seed)
+    ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+           for _ in range(n)]
+    eidx = rng.choice(g.ne, size=n, replace=False)
+    dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    return EdgeEdits.from_lists(insert=ins, delete=dels)
+
+
+def test_apply_edits_flips_version_and_serves_new_graph():
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=401)
+    with Session(g, _cfg()) as s:
+        assert s.version == 0
+        base_fp = s.fingerprint
+        s.query("sssp", start=3, timeout=60)
+        ed = _edits(g, 402, 15)
+        summary = s.apply_edits(ed)
+        assert (s.version, summary["version"]) == (1, 1)
+        assert summary["old_fingerprint"] == base_fp
+        assert s.fingerprint == summary["fingerprint"] != base_fp
+        new_g = DeltaGraph.fresh(g).stack(ed).merged()
+        assert s.graph.ne == new_g.ne == summary["ne"]
+        out = s.query("sssp", start=3, timeout=60)
+        np.testing.assert_array_equal(out["values"],
+                                      reference_sssp(new_g, 3))
+        info = s.snapshot_info()
+        assert info["version"] == 1
+        assert [h["version"] for h in info["history"]] == [0, 1]
+        assert s.stats()["snapshot"]["version"] == 1
+        assert metrics.counter("lux_snapshot_applies_total").value == 1
+
+
+def test_swap_evicts_old_cache_and_retires_old_engines():
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=403)
+    with Session(g, _cfg()) as s:
+        old_fp = s.fingerprint
+        s.query("sssp", start=1, timeout=60)
+        s.query("components", timeout=60)
+        s.query("pagerank", timeout=60)
+        engines_before = s.pool.stats()["engines"]
+        summary = s.apply_edits(_edits(g, 404, 10))
+        assert summary["evicted"] >= 3   # sssp + components + pagerank
+        assert summary["retired"] == engines_before  # all v0 engines
+        assert not any(
+            isinstance(k, tuple) and len(k) > 1 and k[0] == old_fp
+            for k in s.cache.keys()
+        )
+        assert s.pool.stats()["retired"] == engines_before
+        assert s.cache.stats()["invalidations"] == summary["evicted"]
+
+
+def test_incremental_refresh_keeps_fixpoints_warm_and_correct():
+    """With LUX_INCREMENTAL the swap re-populates cached SSSP/components
+    under the new fingerprint from warm starts — served answers right
+    after the swap are cache hits AND bitwise-correct."""
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=405)
+    with Session(g, _cfg()) as s:
+        roots = [2, 9, 55]
+        for r in roots:
+            s.query("sssp", start=r, timeout=60)
+        s.query("components", timeout=60)
+        ed = _edits(g, 406, 10)
+        summary = s.apply_edits(ed)
+        assert summary["refreshed"]["sssp"] == len(roots)
+        assert summary["refreshed"]["components"] == 1
+        new_g = DeltaGraph.fresh(g).stack(ed).merged()
+        hits_before = s.cache.stats()["hits"]
+        for r in roots:
+            out = s.query("sssp", start=r, timeout=60)
+            assert out.get("incremental") is True
+            np.testing.assert_array_equal(out["values"],
+                                          reference_sssp(new_g, r))
+        assert s.cache.stats()["hits"] == hits_before + len(roots)
+
+
+def test_lux_incremental_off_is_evict_only(monkeypatch):
+    monkeypatch.setenv("LUX_INCREMENTAL", "0")
+    metrics.reset()
+    g = generate.gnp(200, 1200, seed=407)
+    with Session(g, _cfg()) as s:
+        s.query("sssp", start=5, timeout=60)
+        summary = s.apply_edits(_edits(g, 408, 5))
+        assert summary["refreshed"] is None
+        assert summary["evicted"] >= 1
+        # Recompute-on-demand still correct.
+        new_g = s.graph
+        out = s.query("sssp", start=5, timeout=60)
+        np.testing.assert_array_equal(out["values"],
+                                      reference_sssp(new_g, 5))
+
+
+def test_warm_timeout_aborts_swap_and_old_version_keeps_serving(
+        monkeypatch):
+    metrics.reset()
+    g = generate.gnp(200, 1200, seed=409)
+    with Session(g, _cfg()) as s:
+        fp0 = s.fingerprint
+        stall = threading.Event()
+        real_warmup = s.warmup
+
+        def slow_warmup(snap=None):
+            if snap is not None and snap.version > 0:
+                stall.wait(5)   # longer than warm_timeout below
+            return real_warmup(snap)
+
+        monkeypatch.setattr(s, "warmup", slow_warmup)
+        with pytest.raises(SnapshotSwapError, match="still serving"):
+            s.apply_edits(_edits(g, 410, 5), warm_timeout=0.05)
+        stall.set()
+        assert s.version == 0 and s.fingerprint == fp0
+        assert metrics.counter("lux_snapshot_aborts_total").value == 1
+        out = s.query("sssp", start=2, timeout=60)   # v0 still serves
+        np.testing.assert_array_equal(out["values"], reference_sssp(g, 2))
+
+
+def test_in_flight_queries_survive_swap_zero_recompiles():
+    """Queries admitted before/during the swap all succeed (each bound to
+    exactly one snapshot), and the warmed engines never recompile —
+    the zero-recompile serving contract holds across hot-swaps."""
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=411)
+    with Session(g, _cfg(window_s=0.05)) as s:
+        sent = s.pool.sentinel
+        # Absorb per-key first-batch compiles before the measured phase.
+        s.query("sssp", start=0, timeout=60)
+        for f in [s.submit("sssp", start=r) for r in (1, 2, 3, 4)]:
+            f.result(60)
+        ed = _edits(g, 412, 10)
+        new_g = DeltaGraph.fresh(g).stack(ed).merged()
+
+        errors, results = [], {}
+        stop = threading.Event()
+
+        def pound():
+            i = 0
+            while not stop.is_set():
+                r = i % 40
+                try:
+                    out = s.query("sssp", start=r, timeout=60)
+                    results[r] = (s.version if "incremental" not in out
+                                  else None, out)
+                except Exception as e:   # any failure fails the test
+                    errors.append(e)
+                i += 1
+
+        threads = [threading.Thread(target=pound) for _ in range(3)]
+        for t in threads:
+            t.start()
+        summary = s.apply_edits(ed)
+        # Post-swap traffic lands on v1 with the same executables.
+        for f in [s.submit("sssp", start=r) for r in (5, 6, 7, 8)]:
+            f.result(60)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert summary["version"] == 1
+        out = s.query("sssp", start=9, timeout=60)
+        np.testing.assert_array_equal(out["values"],
+                                      reference_sssp(new_g, 9))
+        if sent.available:
+            sent.assert_zero_recompiles()
+        assert s.pool.stats()["recompiles"] == 0
+
+
+def test_apply_edits_validates_input():
+    g = generate.gnp(100, 500, seed=413)
+    with Session(g, _cfg()) as s:
+        with pytest.raises(BadQueryError, match="EdgeEdits"):
+            s.apply_edits([(0, 1)])
+        with pytest.raises(BadQueryError, match="vertex ids outside"):
+            s.apply_edits(EdgeEdits.from_lists(insert=[(0, g.nv)]))
+        assert s.version == 0
+
+
+# -- HTTP front end -------------------------------------------------------
+
+
+def _post(base, path, payload, timeout=60):
+    req = urllib.request.Request(
+        base + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return json.loads(resp.read()), dict(resp.headers)
+
+
+def test_http_snapshot_endpoints_and_header():
+    from lux_tpu.serve.http import serve_in_thread
+
+    g = generate.gnp(200, 1200, seed=415)
+    s = Session(g, _cfg(max_batch=2))
+    server, _ = serve_in_thread(s, port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        out, hdr = _post(base, "/query",
+                         {"app": "sssp", "start": 5, "full": True})
+        assert hdr["X-Lux-Snapshot"] == "0"
+        np.testing.assert_array_equal(
+            np.asarray(out["values"], np.uint32), reference_sssp(g, 5))
+
+        resp = urllib.request.urlopen(base + "/snapshot", timeout=10)
+        info = json.loads(resp.read())
+        assert info["version"] == 0 and info["ne"] == g.ne
+
+        rng = np.random.default_rng(416)
+        ins = [[int(rng.integers(g.nv)), int(rng.integers(g.nv))]
+               for _ in range(8)]
+        dels = [[int(g.col_src[e]), int(g.col_dst[e])]
+                for e in rng.choice(g.ne, size=8, replace=False)]
+        summary, hdr = _post(base, "/snapshot",
+                             {"insert": ins, "delete": dels})
+        assert summary["version"] == 1
+        assert hdr["X-Lux-Snapshot"] == "1"
+
+        new_g = DeltaGraph.fresh(g).stack(
+            EdgeEdits.from_lists(
+                insert=[tuple(p) for p in ins],
+                delete=[tuple(p) for p in dels])).merged()
+        out, hdr = _post(base, "/query",
+                         {"app": "sssp", "start": 5, "full": True})
+        assert hdr["X-Lux-Snapshot"] == "1"
+        np.testing.assert_array_equal(
+            np.asarray(out["values"], np.uint32),
+            reference_sssp(new_g, 5))
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/snapshot", {"insert": [[0, g.nv + 7]]})
+        assert ei.value.code == 400
+        assert json.loads(urllib.request.urlopen(
+            base + "/snapshot", timeout=10).read())["version"] == 1
+    finally:
+        server.shutdown()
+        s.close()

@@ -11,9 +11,15 @@ a schema-versioned ``serve_bench.v1`` report — the evidence format
 PERF.md specifies for serving claims, checkable against a baseline via
 tools/slo_check.py (`make serve-slo`).
 
+With ``--swap-at T`` (in-process mode) a ~1% random edit batch is
+applied mid-run via ``session.apply_edits`` — the report gains a
+``snapshot`` block {version, swap_s, errors_during_swap} so SLO checks
+can assert hot-swaps are latency- and error-neutral under load.
+
 Examples:
   python tools/serve_bench.py --scale 12 --workers 16 --duration 10
   python tools/serve_bench.py --url http://127.0.0.1:8399 --workers 32
+  python tools/serve_bench.py --swap-at 5 --duration 10 --json
   python tools/serve_bench.py --json-out /tmp/bench.json && \
       python tools/slo_check.py --input /tmp/bench.json --baseline slo.json
 """
@@ -132,6 +138,9 @@ def main() -> int:
                    dest="sssp_weight",
                    help="fraction of traffic that is SSSP root queries "
                    "(rest splits between pagerank and components)")
+    p.add_argument("--swap-at", type=float, default=None, dest="swap_at",
+                   help="seconds into the run to apply a ~1%% random "
+                   "edit batch and hot-swap serving (in-process mode)")
     p.add_argument("--json", action="store_true",
                    help="emit one machine-readable serve_bench.v1 JSON "
                    "line at the end")
@@ -169,6 +178,11 @@ def main() -> int:
         client = LocalClient(session)
         nv = session.graph.nv
 
+    if args.swap_at is not None and session is None:
+        print("--swap-at requires in-process mode (not --url)",
+              file=sys.stderr)
+        return 2
+
     w = max(0.0, min(1.0, args.sssp_weight))
     mix = [("sssp", w), ("pagerank", (1 - w) / 2),
            ("components", (1 - w) / 2)]
@@ -183,11 +197,54 @@ def main() -> int:
         )
         for i in range(args.workers)
     ]
+    swap_result: dict = {}
+    swap_thread = None
+    if args.swap_at is not None:
+
+        def do_swap():
+            import numpy as np
+
+            from lux_tpu.graph import EdgeEdits
+
+            time.sleep(args.swap_at)
+            g = session.graph
+            rng = np.random.default_rng(99)
+            n = max(2, g.ne // 100)
+            ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+                   for _ in range(n // 2)]
+            dels = [(int(g.col_src[e]), int(g.col_dst[e]))
+                    for e in rng.choice(g.ne, size=n - n // 2,
+                                        replace=False)]
+            errs_before = dict(errs)
+            t_s = time.monotonic()
+            try:
+                summary = session.apply_edits(
+                    EdgeEdits.from_lists(insert=ins, delete=dels))
+                swap_result.update(
+                    version=summary["version"],
+                    swap_s=summary["swap_s"],
+                    evicted=summary["evicted"],
+                    retired=summary["retired"],
+                )
+            except Exception as e:
+                swap_result.update(error=repr(e),
+                                   swap_s=time.monotonic() - t_s)
+            swap_result["errors_during_swap"] = sum(
+                errs.get(k, 0) - errs_before.get(k, 0)
+                for k in set(errs) | set(errs_before)
+            )
+
+        swap_thread = threading.Thread(target=do_swap, daemon=True)
+
     t0 = time.monotonic()
     for t in threads:
         t.start()
+    if swap_thread is not None:
+        swap_thread.start()
     for t in threads:
         t.join()
+    if swap_thread is not None:
+        swap_thread.join(120)
     wall = time.monotonic() - t0
 
     total = sum(len(v) for v in lat.values())
@@ -234,6 +291,15 @@ def main() -> int:
     print(f"  server      shed={report['shed']} "
           f"rejected={report['rejected']} "
           f"recompiles={report['recompiles']}")
+    if swap_result:
+        report["snapshot"] = swap_result
+        if "error" in swap_result:
+            print(f"  snapshot    SWAP FAILED: {swap_result['error']}")
+        else:
+            print(f"  snapshot    v{swap_result['version']} swapped in "
+                  f"{swap_result['swap_s']:.2f}s mid-run, "
+                  f"errors_during_swap="
+                  f"{swap_result['errors_during_swap']}")
     if args.json:
         print(json.dumps(report))
     if args.json_out:

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke race-stress clean
 
 all: native
 
@@ -15,13 +15,19 @@ lint:
 lint-ir:
 	python tools/luxlint.py --ir
 
+# Concurrency tier: thread-shared state vs lock guards, the cross-file
+# lock-order graph, blocking-under-lock, unjoined threads, publish
+# discipline (LUX301-305).
+lint-threads:
+	python tools/luxlint.py --threads
+
 plan-check:
 	python tools/plan_check.py
 
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir plan-check test serve-obs snapshot-smoke
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke race-stress
 
 bench:
 	python bench.py
@@ -45,6 +51,12 @@ merge-smoke:
 # barrier, incremental cache refresh, zero recompiles, one swap trace-id.
 snapshot-smoke:
 	python tools/snapshot_smoke.py
+
+# Concurrency acceptance: burst + mid-burst swap + forced compaction
+# with LockWatch armed — zero lock-order inversions, zero failed
+# queries, zero recompiles, bounded hold-time p99.
+race-stress:
+	python tools/race_stress.py
 
 serve-bench:
 	python tools/serve_bench.py --scale 12 --workers 16 --duration 10

@@ -7,7 +7,11 @@ the tree in well under a second per file):
   inline ``# luxlint: disable=RULE`` suppressions, JSON + human output;
 - :mod:`lux_tpu.analysis.rules` — the rule set targeting this repo's
   real failure modes (host syncs in engine hot loops, recompile hygiene,
-  kernel BlockSpec layout contracts, the LUX_* env-flag registry).
+  kernel BlockSpec layout contracts, the LUX_* env-flag registry);
+- :mod:`lux_tpu.analysis.threads` — the concurrency tier (LUX301-305):
+  thread-shared state vs lock guards, the cross-file lock-order graph,
+  blocking-under-lock, unjoined threads, and atomic-publish discipline.
+  Its runtime twin is ``lux_tpu/utils/locks.py`` (LockWatch).
 
 Runtime side (imports jax; import it lazily):
 
@@ -25,3 +29,7 @@ from lux_tpu.analysis.core import (  # noqa: F401
     run_source,
 )
 from lux_tpu.analysis.rules import all_rules  # noqa: F401
+from lux_tpu.analysis.threads import (  # noqa: F401
+    all_thread_rules,
+    run_threads,
+)

@@ -28,12 +28,12 @@ Semantics (documented, tested in test_delta.py):
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Optional
 
 import numpy as np
 
 from lux_tpu.graph.graph import Graph, W_DTYPE
+from lux_tpu.utils.locks import make_lock
 
 
 def _edge_keys(src: np.ndarray, dst: np.ndarray, nv: int) -> np.ndarray:
@@ -127,7 +127,7 @@ class DeltaGraph:
     del_keys: np.ndarray              # int64, sorted unique, base-relative
 
     def __post_init__(self):
-        self._merge_lock = threading.Lock()
+        self._merge_lock = make_lock("delta.merge")
         self._merged: Optional[Graph] = None
 
     @staticmethod

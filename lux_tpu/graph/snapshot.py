@@ -21,6 +21,7 @@ from lux_tpu.graph.delta import DeltaGraph, EdgeEdits
 from lux_tpu.graph.graph import Graph
 from lux_tpu.obs import metrics, spans
 from lux_tpu.utils import checkpoint, flags
+from lux_tpu.utils.locks import make_lock
 
 _compactions = metrics.counter("lux_snapshot_compactions_total")
 
@@ -31,7 +32,7 @@ class Snapshot:
     def __init__(self, version: int, delta: DeltaGraph):
         self.version = version
         self._delta = delta
-        self._lock = threading.Lock()
+        self._lock = make_lock("snapshot")
         self._fingerprint: Optional[str] = None
         self.compacted = delta.delta_edges == 0
 
@@ -73,7 +74,7 @@ class SnapshotStore:
     """Linear version history with threshold-triggered background compaction."""
 
     def __init__(self, base: Graph):
-        self._lock = threading.Lock()
+        self._lock = make_lock("snapshot.store")
         self._snaps: List[Snapshot] = [Snapshot(0, DeltaGraph.fresh(base))]
         self._compaction_threads: List[threading.Thread] = []
 

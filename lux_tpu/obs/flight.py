@@ -28,18 +28,18 @@ from __future__ import annotations
 import json
 import os
 import signal
-import threading
 import time
 import itertools
 from collections import deque
 from typing import Callable, Dict, Optional
 
 from ..utils import flags
+from ..utils.locks import make_lock
 from . import metrics, spans
 
 DEBOUNCE_S = 1.0
 
-_lock = threading.Lock()
+_lock = make_lock("obs.flight")
 _capacity = int(flags.default("LUX_FLIGHT_CAPACITY"))
 _traces: deque = deque(maxlen=_capacity)
 _iterations: deque = deque(maxlen=_capacity)

@@ -150,6 +150,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[tuple, object] = {}
+        # Deliberately a bare Lock, not utils/locks.make_lock: this
+        # registry is the substrate WatchedLock reports into — a watched
+        # registry lock would re-enter _get from its own release path.
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, labels: Optional[Dict[str, str]], **kw):

@@ -14,11 +14,11 @@ check the window math deterministically. Thread-safe; stdlib only.
 from __future__ import annotations
 
 import bisect
-import threading
 from collections import deque
 from typing import Callable, Dict, Optional, Sequence
 
 from ..utils import flags
+from ..utils.locks import make_lock
 from . import spans
 
 # Per-app retention cap: at 10k qps and a 300 s window this truncates,
@@ -72,7 +72,7 @@ class SloWindows:
         self.quantiles = tuple(quantiles)
         self._now = now if now is not None else spans.monotonic
         self._obs: Dict[str, deque] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.slo")
 
     def observe(self, app: str, seconds: float):
         t = self._now()

@@ -42,6 +42,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
 from ..utils import flags
+from ..utils.locks import make_lock
 from . import metrics, trace
 
 _TRACE_ID: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
@@ -49,7 +50,7 @@ _TRACE_ID: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
 )
 _seq = itertools.count(1)
 
-_lock = threading.Lock()
+_lock = make_lock("obs.spans")
 # trace_id -> open trace record; bounded so an abandoned future can never
 # grow this without limit (oldest open trace is dropped, not dumped).
 _MAX_OPEN = 1024

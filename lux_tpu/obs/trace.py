@@ -23,10 +23,11 @@ import time
 from contextlib import contextmanager
 
 from ..utils import flags
+from ..utils.locks import make_lock
 
 _EPOCH = time.perf_counter()
 
-_lock = threading.Lock()
+_lock = make_lock("obs.trace")
 _path = None
 _writer = None
 

@@ -82,7 +82,9 @@ class MicroBatcher:
         self._batch_hist = metrics.histogram(
             "lux_serve_batch_size", buckets=BATCH_SIZE_BUCKETS
         )
-        self._closed = False
+        # Event, not a bare bool: set by close() on the caller thread,
+        # polled by submit() and the worker (LUX301 discipline).
+        self._closed = threading.Event()
         self._carry: Optional[Request] = None   # worker-thread-only state
         self._thread = threading.Thread(
             target=self._loop, name="lux-serve-batcher", daemon=True
@@ -93,7 +95,7 @@ class MicroBatcher:
 
     def submit(self, req: Request) -> Future:
         """Admit ``req`` or raise ``QueueFullError`` without blocking."""
-        if self._closed:
+        if self._closed.is_set():
             raise QueueFullError("server is shutting down")
         with spans.span("serve.admit", app=req.app):
             try:
@@ -146,7 +148,7 @@ class MicroBatcher:
                 try:
                     first = self._q.get(timeout=0.1)
                 except queue.Empty:
-                    if self._closed:
+                    if self._closed.is_set():
                         return
                     continue
             t_asm = spans.clock()
@@ -195,7 +197,7 @@ class MicroBatcher:
 
     def close(self, timeout: float = 5.0):
         """Stop admitting, drain the worker, fail leftover requests."""
-        self._closed = True
+        self._closed.set()
         self._thread.join(timeout)
         while True:
             try:

@@ -10,11 +10,11 @@ rotated onto a new graph can never serve stale arrays.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
 from lux_tpu.obs import metrics, spans
+from lux_tpu.utils.locks import make_lock
 
 
 class ResultCache:
@@ -25,7 +25,7 @@ class ResultCache:
             raise ValueError(f"capacity must be >= 1 (got {capacity})")
         self.capacity = capacity
         self._d: "OrderedDict[Hashable, Any]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache")
         self._hits = metrics.counter("lux_serve_cache_hits_total")
         self._misses = metrics.counter("lux_serve_cache_misses_total")
         self._evictions = metrics.counter("lux_serve_cache_evictions_total")

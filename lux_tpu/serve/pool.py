@@ -17,12 +17,12 @@ in a watch region is a recompile the stats (and the serve tests) flag.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Hashable
 
 from lux_tpu.analysis.sentinel import RecompileSentinel
 from lux_tpu.obs import metrics, spans
 from lux_tpu.utils import flags
+from lux_tpu.utils.locks import make_lock
 
 
 class EnginePool:
@@ -30,7 +30,7 @@ class EnginePool:
 
     def __init__(self, scope: str = "serve"):
         self._engines = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("pool")
         self._hits = metrics.counter("lux_serve_pool_hits_total")
         self._misses = metrics.counter("lux_serve_pool_misses_total")
         # Created eagerly so a clean pool still exports 0 — the serve
@@ -59,6 +59,11 @@ class EnginePool:
                 with self.sentinel.expect(key):
                     ex = factory()
                     if hasattr(ex, "warmup"):
+                        # First-build warmup deliberately holds the lock:
+                        # releasing would let a concurrent request compile
+                        # the same engine twice. LockWatch hold warnings
+                        # track the cost instead.
+                        # luxlint: disable=LUX303 -- single-compile guarantee needs the lock
                         ex.warmup()
             self._audit(key, ex)
             self._engines[key] = ex

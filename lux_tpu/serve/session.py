@@ -48,6 +48,7 @@ from lux_tpu.serve.errors import (BadQueryError, QueueFullError,
                                   SnapshotSwapError)
 from lux_tpu.serve.pool import EnginePool
 from lux_tpu.utils import flags
+from lux_tpu.utils.locks import make_lock
 from lux_tpu.utils.logging import get_logger
 
 
@@ -96,8 +97,8 @@ class Session:
             self.graph_path = graph
             graph = native_io.read_lux(graph)
         self.store = SnapshotStore(graph)
-        self._serving = self.store.current()
-        self._swap_lock = threading.Lock()
+        self._serving = self.store.current()  # luxlint: publish=_swap_lock
+        self._swap_lock = make_lock("session.swap")
         self.pool = EnginePool()
         self.cache = ResultCache(self.config.cache_capacity)
         self.batcher = MicroBatcher(
@@ -344,12 +345,14 @@ class Session:
         jit that warmup's single-step path doesn't reach) and counts as
         warmup; every later execution promises zero compiles — the
         "zero recompiles after the first batch" serving contract."""
+        # luxlint: disable=LUX301 -- _served_keys is batcher-thread-only
         if key in self._served_keys:
             with self.pool.sentinel.watch(key):
                 yield
         else:
             with self.pool.sentinel.expect(key):
                 yield
+            # luxlint: disable=LUX301 -- _watched only runs on the batcher thread
             self._served_keys.add(key)
 
     def _execute_batch(self, batch: List[Request]):
@@ -520,7 +523,7 @@ class Session:
             refreshed = self._incremental_refresh(old, snap, edits)
 
         # The atomic flip: requests admitted after this line bind to N+1.
-        self._serving = snap
+        self._serving = snap  # luxlint: guarded-by=_swap_lock -- apply_edits holds it
         metrics.gauge("lux_snapshot_version").set(float(snap.version))
         metrics.counter("lux_snapshot_applies_total").inc()
 
@@ -560,11 +563,12 @@ class Session:
             )
             # _served_keys is batcher-thread-only state and the barrier
             # runs on the batcher thread: prune without a lock.
-            self._served_keys = {
-                k for k in self._served_keys
-                if not (isinstance(k, tuple) and len(k) > 1
-                        and k[1] == old_fp)
-            }
+            # luxlint: disable=LUX301 -- barrier runs on the batcher thread
+            stale = {k for k in self._served_keys
+                     if isinstance(k, tuple) and len(k) > 1
+                     and k[1] == old_fp}
+            # luxlint: disable=LUX301 -- barrier runs on the batcher thread
+            self._served_keys -= stale
             return {"evicted": evicted, "retired": retired}
 
         while True:

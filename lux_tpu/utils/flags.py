@@ -219,6 +219,17 @@ define("LUX_PLANCK_INFLATION", 8.0,
        "(rows per level / ceil(reals/128)) a saved plan may carry",
        kind="float")
 
+# Concurrency discipline (utils/locks.py, tools/race_stress.py)
+define("LUX_LOCKWATCH", False,
+       "wrap every utils/locks.make_lock in the LockWatch sentinel: "
+       "per-thread acquisition stacks, online lock-order inversion "
+       "detection, lux_lock_{wait,hold}_seconds histograms (set before "
+       "import; locks are wrapped at construction)", kind="bool")
+define("LUX_LOCK_HOLD_WARN_MS", 250.0,
+       "LockWatch: warn + count lux_lock_hold_warnings_total when a "
+       "watched lock is held longer than this many ms (0 disables)",
+       kind="float")
+
 # Dynamic graphs (graph/snapshot.py, engine/incremental.py,
 # serve/session.py)
 define("LUX_DELTA_COMPACT_RATIO", 0.05,

@@ -1,0 +1,19 @@
+"""LUX303 fixture: unbounded blocking while a lock is held."""
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+
+def drain(worker):
+    with _lock:
+        item = _q.get()                           # expect: LUX303
+        worker.join()                             # expect: LUX303
+        return item
+
+
+def nap():
+    with _lock:
+        time.sleep(0.1)                           # expect: LUX303

@@ -1,0 +1,17 @@
+"""LUX302 fixture: A->B in forward, B->A in backward — a static cycle."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward():
+    with a_lock:
+        with b_lock:                              # expect: LUX302
+            return 1
+
+
+def backward():
+    with b_lock:
+        with a_lock:                              # expect: LUX302
+            return 2

@@ -1,0 +1,21 @@
+"""LUX305 fixture: publish-pointer discipline violations."""
+import threading
+
+
+class Server:
+    def __init__(self, snap):
+        self._swap_lock = threading.Lock()
+        self._serving = snap      # luxlint: publish=_swap_lock
+
+    def swap(self, snap):
+        self._serving = snap                      # expect: LUX305
+
+    def answer(self):
+        a = self._serving
+        b = self._serving                         # expect: LUX305
+        return a, b
+
+    def double_flip(self, snap):
+        with self._swap_lock:
+            self._serving = snap
+            self._serving = snap                  # expect: LUX305

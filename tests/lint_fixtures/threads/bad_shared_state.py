@@ -1,0 +1,20 @@
+"""LUX301 fixture: thread-shared attrs accessed without their lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.jobs_done = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for _ in range(8):
+            self.jobs_done += 1                   # expect: LUX301
+
+    def report(self):
+        return self.jobs_done                     # expect: LUX301
+
+    def close(self):
+        self._thread.join(1.0)

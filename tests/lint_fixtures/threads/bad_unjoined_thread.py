@@ -1,0 +1,11 @@
+"""LUX304 fixture: spawned threads with no join/drain path."""
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn, daemon=True)  # expect: LUX304
+    t.start()
+
+
+def spawn_inline(fn):
+    threading.Thread(target=fn).start()           # expect: LUX304

@@ -1,0 +1,20 @@
+"""LUX303 clean: bounded waits under the lock, slow work outside it."""
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+
+def drain(worker):
+    with _lock:
+        item = _q.get(timeout=0.5)
+    worker.join(1.0)
+    return item
+
+
+def nap():
+    time.sleep(0.1)
+    with _lock:
+        return _q.qsize()

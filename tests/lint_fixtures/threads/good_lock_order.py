@@ -1,0 +1,16 @@
+"""LUX302 clean: every function acquires in the same global order."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward():
+    with a_lock:
+        with b_lock:
+            return 1
+
+
+def also_forward():
+    with a_lock, b_lock:
+        return 2

@@ -1,0 +1,17 @@
+"""LUX305 clean: one write per swap under the declared lock; readers
+grab the pointer once into a local."""
+import threading
+
+
+class Server:
+    def __init__(self, snap):
+        self._swap_lock = threading.Lock()
+        self._serving = snap      # luxlint: publish=_swap_lock
+
+    def swap(self, snap):
+        with self._swap_lock:
+            self._serving = snap
+
+    def answer(self):
+        snap = self._serving
+        return snap, snap

@@ -1,0 +1,26 @@
+"""LUX301 clean: shared attrs guarded by their declared lock, plus the
+guarded-by annotation for a cross-method holder."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.jobs_done = 0            # luxlint: guarded-by=_lock
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for _ in range(8):
+            with self._lock:
+                self.jobs_done += 1
+
+    def _bump_locked(self):
+        self.jobs_done += 1           # luxlint: guarded-by=_lock -- callers hold it
+
+    def report(self):
+        with self._lock:
+            return self.jobs_done
+
+    def close(self):
+        self._thread.join(1.0)

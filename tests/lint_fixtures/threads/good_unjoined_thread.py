@@ -1,0 +1,28 @@
+"""LUX304 clean: join directly, return to the caller, or register in a
+container a drain function joins (the drain_compactions shape)."""
+import threading
+
+_threads = []
+
+
+def run_sync(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(5.0)
+
+
+def spawn_for_caller(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def spawn_registered(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    _threads.append(t)
+
+
+def drain(timeout=5.0):
+    for t in _threads:
+        t.join(timeout)

@@ -1,0 +1,417 @@
+"""luxlint-threads: the concurrency tier (LUX301-305), the annotation
+conventions, the CLI --threads contract, and the LockWatch runtime
+sentinel (lux_tpu/utils/locks.py).
+
+Fixture convention mirrors test_analysis.py: `bad_*` files under
+tests/lint_fixtures/threads/ carry `# expect: LUX3NN` markers on exactly
+the lines a finding must anchor to; `good_*` files must lint clean.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lux_tpu.analysis.core import run_source
+from lux_tpu.analysis.threads import (all_thread_rules, build_lock_graph,
+                                      run_threads)
+from lux_tpu.obs import metrics
+from lux_tpu.utils import locks
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+FIXTURES = os.path.join(TESTS, "lint_fixtures", "threads")
+LUXLINT = os.path.join(REPO, "tools", "luxlint.py")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9_,\s]+?)\s*$")
+
+BAD_FIXTURES = (
+    "bad_shared_state.py",
+    "bad_lock_order.py",
+    "bad_blocking_under_lock.py",
+    "bad_unjoined_thread.py",
+    "bad_publish.py",
+)
+GOOD_FIXTURES = (
+    "good_shared_state.py",
+    "good_lock_order.py",
+    "good_blocking_under_lock.py",
+    "good_unjoined_thread.py",
+    "good_publish.py",
+)
+# bad fixture -> the one rule it seeds
+RULE_OF = {
+    "bad_shared_state.py": "LUX301",
+    "bad_lock_order.py": "LUX302",
+    "bad_blocking_under_lock.py": "LUX303",
+    "bad_unjoined_thread.py": "LUX304",
+    "bad_publish.py": "LUX305",
+}
+
+
+def _expected(path):
+    want = {}
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                want[i] = sorted(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+    return want
+
+
+def _lint_threads(path):
+    report = run_threads([path], graph_paths=[path])
+    (res,) = report.results
+    return res
+
+
+def _by_line(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.line, []).append(f.rule)
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, LUXLINT, *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def _summary_line(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("LUXLINT ")]
+    assert lines, stdout
+    return json.loads(lines[-1][len("LUXLINT "):])
+
+
+# -- rules vs fixtures ----------------------------------------------------
+
+
+@pytest.mark.parametrize("rel", BAD_FIXTURES)
+def test_bad_fixture_fires_exactly_where_expected(rel):
+    path = os.path.join(FIXTURES, rel)
+    res = _lint_threads(path)
+    assert res.error is None
+    want = _expected(path)
+    assert want, f"{rel} has no expect markers"
+    assert _by_line(res.findings) == want
+    assert {f.rule for f in res.findings} == {RULE_OF[rel]}
+
+
+@pytest.mark.parametrize("rel", GOOD_FIXTURES)
+def test_good_fixture_is_clean(rel):
+    res = _lint_threads(os.path.join(FIXTURES, rel))
+    assert res.error is None
+    assert res.findings == []
+
+
+# -- LUX301 semantics -----------------------------------------------------
+
+
+_WORKER_TMPL = """
+import threading
+
+
+class W:
+    def __init__(self):
+        self.n = 0{decl}
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        with self.{guard}:
+            self.n += 1
+
+    def read(self):
+        with self._lock:
+            return self.n
+
+    def close(self):
+        self._t.join(1.0)
+"""
+
+
+def _lint_source(src):
+    return run_source(src, "t.py", all_thread_rules())
+
+
+def test_guarded_by_declaration_requires_that_specific_lock():
+    # Declared guarded-by=_lock: guarding with a *different* lock is
+    # still a finding; guarding with the declared one is clean.
+    decl = "            # luxlint: guarded-by=_lock"
+    src = _WORKER_TMPL.format(decl="  # luxlint: guarded-by=_lock",
+                              guard="_aux_lock")
+    res = _lint_source(src)
+    assert [f.rule for f in res.findings] == ["LUX301"], (decl, res.findings)
+    src = _WORKER_TMPL.format(decl="  # luxlint: guarded-by=_lock",
+                              guard="_lock")
+    assert _lint_source(src).findings == []
+
+
+def test_any_lock_suffices_without_a_declaration():
+    src = _WORKER_TMPL.format(decl="", guard="_aux_lock")
+    assert _lint_source(src).findings == []
+
+
+def test_sync_primitive_attrs_are_exempt():
+    src = """
+import queue
+import threading
+
+
+class W:
+    def __init__(self):
+        self.q = queue.Queue()
+        self.done = threading.Event()
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        self.q.put(1)
+        self.done.set()
+
+    def close(self):
+        self.done.wait(1.0)
+        self._t.join(1.0)
+"""
+    assert _lint_source(src).findings == []
+
+
+def test_suppression_counts_not_silent():
+    src = """
+import threading
+
+
+class W:
+    def __init__(self):
+        self.n = 0
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        # luxlint: disable=LUX301 -- single-writer by construction
+        self.n += 1
+
+    def read(self):
+        return self.n  # luxlint: disable=LUX301 -- approximate stat read
+
+    def close(self):
+        self._t.join(1.0)
+"""
+    res = _lint_source(src)
+    assert res.findings == []
+    assert len(res.suppressed) == 2
+
+
+def test_worker_registration_counts_as_thread_entry():
+    # The MicroBatcher shape: a method reference handed to a
+    # *batcher/worker* consumer runs on that consumer's thread.
+    src = """
+class S:
+    def __init__(self, batcher_cls):
+        self.hits = 0
+        self.batcher = batcher_cls(self._execute)
+
+    def _execute(self, batch):
+        self.hits += 1
+
+    def stats(self):
+        return self.hits
+"""
+    res = _lint_source(src)
+    assert {f.rule for f in res.findings} == {"LUX301"}
+    assert len(res.findings) == 2
+
+
+# -- LUX302 cross-file graph ----------------------------------------------
+
+
+def test_lock_order_cycle_across_files(tmp_path):
+    (tmp_path / "m1.py").write_text(
+        "import threading\n"
+        "a_lock = threading.Lock()\n"
+        "b_lock = threading.Lock()\n\n\n"
+        "def fwd():\n"
+        "    with a_lock:\n"
+        "        with b_lock:\n"
+        "            pass\n"
+    )
+    m2 = tmp_path / "m2.py"
+    m2.write_text(
+        "import m1\n\n\n"
+        "def bwd():\n"
+        "    with m1.b_lock:\n"
+        "        with m1.a_lock:\n"
+        "            pass\n"
+    )
+    # Lint only m2 (the --changed shape) with the graph built over the
+    # whole tree: the inversion against m1's order must still fire.
+    report = run_threads([str(m2)], graph_paths=[str(tmp_path)])
+    (res,) = report.results
+    assert [f.rule for f in res.findings] == ["LUX302"]
+    assert "m1.a_lock" in res.findings[0].message
+
+
+def test_lock_graph_consistent_order_has_no_cycles(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "a_lock = threading.Lock()\n"
+        "b_lock = threading.Lock()\n\n\n"
+        "def f():\n"
+        "    with a_lock:\n"
+        "        with b_lock:\n"
+        "            pass\n\n\n"
+        "def g():\n"
+        "    with a_lock, b_lock:\n"
+        "        pass\n"
+    )
+    assert build_lock_graph([str(tmp_path)]) == {}
+
+
+# -- CLI contract ---------------------------------------------------------
+
+
+def test_cli_threads_full_tree_is_green():
+    # The gate `make lint-threads` runs: the shipped tree must lint
+    # clean under all five LUX30x rules, intentional exceptions
+    # suppressed with reasons and *counted*.
+    proc = _run_cli("--threads")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    s = _summary_line(proc.stdout)
+    assert s["schema"] == "luxlint-threads.v1"
+    assert s["ok"] is True and s["findings"] == 0 and s["errors"] == 0
+    assert s["files"] > 50
+    assert s["suppressed"] >= 5    # pool warmup + session _served_keys
+
+
+@pytest.mark.parametrize("rel", BAD_FIXTURES)
+def test_cli_threads_rc1_on_each_seeded_fixture(rel):
+    proc = _run_cli("--threads", "--json",
+                    os.path.join("tests", "lint_fixtures", "threads", rel))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    s = _summary_line(proc.stdout)
+    assert s["schema"] == "luxlint-threads.v1" and s["ok"] is False
+    assert set(s["by_rule"]) == {RULE_OF[rel]}
+    payload = json.loads(proc.stdout[:proc.stdout.rfind("LUXLINT ")])
+    assert payload["summary"]["schema"] == "luxlint-threads.v1"
+    assert all(f["rule"] == RULE_OF[rel] for f in payload["findings"])
+
+
+def test_cli_list_rules_includes_threads_tier():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("LUX301", "LUX302", "LUX303", "LUX304", "LUX305"):
+        assert rid in proc.stdout
+
+
+def test_cli_threads_baseline_ratchet(tmp_path):
+    fix = os.path.join("tests", "lint_fixtures", "threads", "bad_publish.py")
+    base = str(tmp_path / "threads_baseline.json")
+    p1 = _run_cli("--threads", fix, "--baseline", base)
+    assert p1.returncode == 0 and "baseline written" in p1.stdout
+    keys = json.load(open(base))["keys"]
+    assert keys and all(k.startswith("LUX305\t") for k in keys)
+    # Same findings again: ratchet holds.
+    p2 = _run_cli("--threads", fix, "--baseline", base)
+    assert p2.returncode == 0, p2.stdout
+    # A finding outside the snapshot is new -> fail.
+    p3 = _run_cli("--threads", fix,
+                  os.path.join("tests", "lint_fixtures", "threads",
+                               "bad_shared_state.py"),
+                  "--baseline", base)
+    assert p3.returncode == 1 and "[new]" in p3.stdout
+
+
+# -- LockWatch runtime sentinel -------------------------------------------
+
+
+def test_make_lock_inert_without_flag(monkeypatch):
+    monkeypatch.delenv("LUX_LOCKWATCH", raising=False)
+    lk = locks.make_lock("tw.inert")
+    assert isinstance(lk, type(threading.Lock()))
+    assert not isinstance(lk, locks.WatchedLock)
+
+
+def test_make_lock_watched_under_flag(monkeypatch):
+    monkeypatch.setenv("LUX_LOCKWATCH", "1")
+    lk = locks.make_lock("tw.watched")
+    assert isinstance(lk, locks.WatchedLock)
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_lockwatch_detects_abba_inversion():
+    watch = locks.LockWatch()
+    a = locks.WatchedLock("tw.abba.a", watch=watch)
+    b = locks.WatchedLock("tw.abba.b", watch=watch)
+    with a:
+        with b:
+            pass
+    assert watch.inversions() == []
+    with b:
+        with a:
+            pass
+    inv = watch.inversions()
+    assert len(inv) == 1
+    assert set(inv[0]["cycle"]) == {"tw.abba.a", "tw.abba.b"}
+    assert inv[0]["stack"] and inv[0]["prior_stack"]
+    with pytest.raises(AssertionError, match="inversion"):
+        watch.assert_no_inversions()
+    # The same pair never double-reports.
+    with b:
+        with a:
+            pass
+    assert len(watch.inversions()) == 1
+
+
+def test_lockwatch_consistent_order_is_clean():
+    watch = locks.LockWatch()
+    a = locks.WatchedLock("tw.ok.a", watch=watch)
+    b = locks.WatchedLock("tw.ok.b", watch=watch)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    watch.assert_no_inversions()
+    st = watch.stats()
+    assert st["inversions"] == 0
+    assert st["order"] == {"tw.ok.a": ["tw.ok.b"]}
+    watch.reset()
+    assert watch.stats()["edges"] == 0
+
+
+def test_lockwatch_hold_and_wait_histograms():
+    lk = locks.WatchedLock("tw.hist", watch=locks.LockWatch())
+    with lk:
+        time.sleep(0.002)
+    q = locks.hold_quantile("tw.hist", 0.99)
+    assert q is not None and q > 0
+    wait_h = metrics.histogram("lux_lock_wait_seconds", {"lock": "tw.hist"},
+                               buckets=locks.LOCK_BUCKETS)
+    assert wait_h.count >= 1
+    assert locks.hold_quantile("tw.never-used", 0.99) is None
+
+
+def test_lockwatch_hold_warning_counter(monkeypatch):
+    monkeypatch.setenv("LUX_LOCK_HOLD_WARN_MS", "1")
+    lk = locks.WatchedLock("tw.warn", watch=locks.LockWatch())
+    with lk:
+        time.sleep(0.01)
+    c = metrics.counter("lux_lock_hold_warnings_total", {"lock": "tw.warn"})
+    assert c.value >= 1
+    monkeypatch.setenv("LUX_LOCK_HOLD_WARN_MS", "0")   # 0 disables
+    before = c.value
+    with lk:
+        time.sleep(0.01)
+    assert c.value == before

@@ -13,6 +13,9 @@ Usage:
     python tools/luxlint.py --ir fixture.py  # trace a module's TRACES list
     python tools/luxlint.py --plans DIR...   # verify saved GroupedTailPlan
                                              #   artifacts (LUX2xx, jax-free)
+    python tools/luxlint.py --threads        # concurrency tier: lock
+                                             #   discipline + lock-order graph
+                                             #   (LUX3xx, stdlib AST)
     python tools/luxlint.py --baseline F     # snapshot/compare: only findings
                                              #   absent from F fail the run
 
@@ -36,6 +39,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 from lux_tpu.analysis import all_rules, run_paths  # noqa: E402
+from lux_tpu.analysis.threads import all_thread_rules, run_threads  # noqa: E402
 
 DEFAULT_TARGETS = ("lux_tpu", "tools", "bench.py")
 
@@ -143,19 +147,28 @@ def main(argv=None) -> int:
     ap.add_argument("--plans", action="store_true",
                     help="verify saved GroupedTailPlan artifact dirs "
                          "(LUX201-205; jax-free, mmap load)")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the concurrency tier (LUX301-305): thread-"
+                         "shared state, lock-order graph, blocking-under-"
+                         "lock, unjoined threads, publish discipline")
     ap.add_argument("--changed", action="store_true",
-                    help="AST tier only: restrict to .py files changed vs "
-                         "git HEAD (plus untracked)")
+                    help="AST/threads tiers: restrict to .py files changed "
+                         "vs git HEAD (plus untracked); the threads tier "
+                         "still builds its lock-order graph over the whole "
+                         "tree")
     ap.add_argument("--baseline", default="",
                     help="snapshot file: if missing, write current findings "
                          "and pass; if present, fail only on new findings")
     args = ap.parse_args(argv)
 
-    if args.ir and args.plans:
-        ap.error("--ir and --plans are separate tiers; run them separately")
+    if sum((args.ir, args.plans, args.threads)) > 1:
+        ap.error("--ir, --plans, and --threads are separate tiers; run "
+                 "them separately")
 
     if args.list_rules:
         for r in all_rules():
+            print(f"{r.id}  {r.title}\n       {r.doc}")
+        for r in all_thread_rules():
             print(f"{r.id}  {r.title}\n       {r.doc}")
         # The IR/plan tiers import numpy/jax; keep --list-rules instant by
         # documenting them from their modules only when importable cheaply.
@@ -175,6 +188,30 @@ def main(argv=None) -> int:
         if not args.paths:
             ap.error("--plans requires at least one artifact directory")
         report = _run_plans(args.paths, args.select)
+    elif args.threads:
+        select = None
+        if args.select:
+            select = {s.strip() for s in args.select.split(",") if s.strip()}
+            unknown = select - {r.id for r in all_thread_rules()}
+            if unknown:
+                ap.error(f"unknown rule id(s): {sorted(unknown)}")
+        tree = [os.path.join(_REPO, t) for t in DEFAULT_TARGETS]
+        if args.changed:
+            paths = _changed_paths()
+            if not paths:
+                print("luxlint: --changed: no modified .py files")
+                print("LUXLINT " + json.dumps(
+                    {"schema": "luxlint-threads.v1", "files": 0,
+                     "findings": 0, "errors": 0, "ok": True},
+                    sort_keys=True))
+                return 0
+            graph_paths = tree   # order graph stays whole-tree
+        elif args.paths:
+            paths = args.paths
+            graph_paths = paths  # explicit targets are self-contained
+        else:
+            paths = graph_paths = tree
+        report = run_threads(paths, select=select, graph_paths=graph_paths)
     else:
         rules = all_rules()
         if args.select:

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke race-stress clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -27,7 +27,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke race-stress
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke race-stress chaos-stress
 
 bench:
 	python bench.py
@@ -57,6 +57,13 @@ snapshot-smoke:
 # queries, zero recompiles, bounded hold-time p99.
 race-stress:
 	python tools/race_stress.py
+
+# Robustness acceptance: burst with every fault point armed (all
+# requests terminal), breaker open->half_open->closed lifecycle, and an
+# injected crash recovered bitwise from the WAL with zero steady-state
+# recompiles. The WAL torn-write unit tests run under `test`.
+chaos-stress:
+	python tools/chaos_stress.py
 
 serve-bench:
 	python tools/serve_bench.py --scale 12 --workers 16 --duration 10

@@ -24,6 +24,11 @@ code previously only promised in prose:
   obs.spans helpers (clock() for durations on the trace epoch,
   monotonic() for deadlines), never raw time.* — mixed clock sources
   corrupt SLO math and trace alignment.
+- LUX007 swallowed-exception: serve/engine handlers that catch
+  Exception/BaseException (or bare ``except``) must do more than log
+  and move on — a dropped engine error is an answer somebody never
+  gets, and the fault-injection harness (utils/faults.py) only proves
+  anything if injected failures surface as terminal statuses.
 
 All pure ``ast``; no jax, no numpy.
 """
@@ -509,6 +514,75 @@ class ClockDiscipline(Rule):
         return out
 
 
+class SwallowedException(Rule):
+    id = "LUX007"
+    title = "swallowed-exception"
+    doc = ("serve/engine handlers catching Exception/BaseException (or "
+           "bare except) must not reduce to log-and-drop — re-raise, "
+           "convert to a typed ServeError, resolve the request's future, "
+           "or record state the caller observes")
+
+    # A handler whose whole body is pass/continue/bare-return plus calls
+    # that only say something matches "swallow". Matching is on the
+    # dotted-name parts, so self.log.warning, logging.error, print, and
+    # logger.exception all count as log-only; metrics increments, future
+    # resolution, and flight dumps count as real work (observable state).
+    _LOG_PARTS = frozenset((
+        "log", "logger", "logging", "print", "warn", "warning", "debug",
+        "info", "error", "exception",
+    ))
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        return "serve/" in ctx.posix_path or "engine/" in ctx.posix_path
+
+    @classmethod
+    def _broad(cls, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:            # bare except
+            return True
+        elts = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                else [handler.type])
+        return any((_dotted(e) or "") in ("Exception", "BaseException")
+                   for e in elts)
+
+    @classmethod
+    def _inert(cls, stmt: ast.stmt) -> bool:
+        """True for statements that drop the error on the floor."""
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            return True
+        if isinstance(stmt, ast.Return):
+            return stmt.value is None or (
+                isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is None
+            )
+        if isinstance(stmt, ast.Expr):
+            if isinstance(stmt.value, ast.Constant):
+                return True                 # stray docstring
+            if isinstance(stmt.value, ast.Call):
+                name = _dotted(stmt.value.func) or ""
+                return any(p.lower() in cls._LOG_PARTS
+                           for p in name.split("."))
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(node):
+                continue
+            if all(self._inert(s) for s in node.body):
+                caught = ("bare except" if node.type is None
+                          else _dotted(node.type) or "broad except")
+                out.append(self.finding(
+                    ctx, node,
+                    f"{caught} swallows the error (log-and-drop body) — "
+                    "re-raise, map to a typed ServeError, or make the "
+                    "failure observable (resolve the future / record "
+                    "state); silent drops hide real engine faults",
+                ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         HostSyncInHotLoop(),
@@ -517,4 +591,5 @@ def all_rules() -> List[Rule]:
         EnvFlagRegistry(),
         DirectEnvRead(),
         ClockDiscipline(),
+        SwallowedException(),
     ]

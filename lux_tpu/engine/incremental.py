@@ -50,6 +50,7 @@ import numpy as np
 from lux_tpu.engine.push import (MultiSourcePushExecutor, PushExecutor,
                                  PushState)
 from lux_tpu.graph.graph import Graph
+from lux_tpu.utils import faults
 
 
 def _relax_np(program, vals: np.ndarray, w) -> np.ndarray:
@@ -192,6 +193,7 @@ class IncrementalExecutor:
             recorder=None, **init_kw):
         """Fixpoint from the warm state; returns ``(state, iters, info)``
         with ``state.values`` bitwise-equal to a from-scratch run."""
+        faults.point("serve.engine.execute")
         state, info = self.warm_state(old_values, removed, inserted,
                                       **init_kw)
         state, iters = self.push.run(max_iters=max_iters, state=state,
@@ -208,6 +210,7 @@ class IncrementalExecutor:
         like ``init_state`` so the warmed executable is reused."""
         if self.multi is None:
             raise ValueError("no MultiSourcePushExecutor attached")
+        faults.point("serve.engine.execute")
         starts = list(starts)
         cols = list(old_columns)
         if len(starts) != len(cols):

@@ -3,6 +3,7 @@ from lux_tpu.graph.format import (detect_layout, read_lux, read_lux_mmap, write_
 from lux_tpu.graph.partition import edge_balanced_bounds, PartitionInfo
 from lux_tpu.graph.delta import DeltaGraph, EdgeEdits
 from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
+from lux_tpu.graph.wal import (RecoveryResult, Wal, WalCorruptError, replay)
 from lux_tpu.graph import generate
 
 __all__ = [
@@ -11,6 +12,10 @@ __all__ = [
     "EdgeEdits",
     "Snapshot",
     "SnapshotStore",
+    "Wal",
+    "WalCorruptError",
+    "RecoveryResult",
+    "replay",
     "read_lux",
     "read_lux_mmap",
     "write_lux",

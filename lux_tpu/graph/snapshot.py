@@ -10,6 +10,16 @@ background compaction thread that re-anchors the delta on the merged CSC
 — the merged arrays are reused as-is, so compaction never changes the
 fingerprint (tested: compaction round-trips are bitwise no-ops for
 readers).
+
+Durability (PR 9): pass ``wal_dir`` (or set ``LUX_WAL_DIR``) and the
+store writes every edit batch through :mod:`lux_tpu.graph.wal` *before*
+any version is minted — ``enqueue`` logs + stages a batch without
+swapping (ROADMAP item 3's write-ahead queue; many small batches
+coalesce into one ``apply``), ``apply`` folds all staged batches, mints
+version N+1, and seals it with a fingerprinted commit record.
+:meth:`SnapshotStore.recover` replays the log on startup onto the base
+graph, yielding a bitwise-identical current snapshot with any
+uncommitted batches re-staged.
 """
 
 from __future__ import annotations
@@ -73,10 +83,37 @@ class Snapshot:
 class SnapshotStore:
     """Linear version history with threshold-triggered background compaction."""
 
-    def __init__(self, base: Graph):
+    def __init__(self, base: Graph, wal_dir: Optional[str] = None):
         self._lock = make_lock("snapshot.store")
         self._snaps: List[Snapshot] = [Snapshot(0, DeltaGraph.fresh(base))]
         self._compaction_threads: List[threading.Thread] = []
+        self._pending: List[EdgeEdits] = []
+        self._wal = None
+        if wal_dir:
+            from lux_tpu.graph.wal import Wal
+            self._wal = Wal(wal_dir)
+
+    @classmethod
+    def recover(cls, base: Graph, wal_dir: str) -> "SnapshotStore":
+        """Rebuild a store from ``base`` plus the WAL in ``wal_dir``.
+
+        The recovered current snapshot is bitwise-identical to the last
+        *committed* (minted) version before the crash — a torn tail
+        record is truncated, never fatal — and edit batches logged but
+        not yet committed are re-staged as pending, so the next
+        ``apply()`` mints them exactly as the dead process would have.
+        Raises :class:`~lux_tpu.graph.wal.WalCorruptError` on interior
+        damage rather than serving a silently wrong graph."""
+        from lux_tpu.graph import wal as walmod
+        result = walmod.replay(base, wal_dir)
+        store = cls(result.graph, wal_dir=wal_dir)
+        # Version numbering resumes where the dead process left off: the
+        # log's commit records carry versions, and downstream state
+        # (metrics, serving summaries) must not watch versions run
+        # backwards across a restart.
+        store._snaps[-1].version = result.version
+        store._pending.extend(result.pending)
+        return store
 
     # -- reads -----------------------------------------------------------
 
@@ -86,9 +123,12 @@ class SnapshotStore:
 
     def get(self, version: int) -> Snapshot:
         with self._lock:
-            if not 0 <= version < len(self._snaps):
+            # After recover() the history starts at the replayed version,
+            # not 0 — index relative to the first retained snapshot.
+            idx = version - self._snaps[0].version
+            if not 0 <= idx < len(self._snaps):
                 raise KeyError(f"unknown snapshot version {version}")
-            return self._snaps[version]
+            return self._snaps[idx]
 
     def history(self) -> List[dict]:
         with self._lock:
@@ -103,22 +143,77 @@ class SnapshotStore:
             for s in snaps
         ]
 
+    def pending_edits(self) -> int:
+        """Batches enqueued behind the WAL but not yet minted."""
+        with self._lock:
+            return len(self._pending)
+
+    def pending_batches(self) -> tuple:
+        """Snapshot of the enqueued batches (read-only; apply() drains)."""
+        with self._lock:
+            return tuple(self._pending)
+
+    def wal_stats(self) -> Optional[dict]:
+        return self._wal.stats() if self._wal is not None else None
+
     # -- writes ----------------------------------------------------------
 
-    def apply(self, edits: EdgeEdits,
+    def enqueue(self, edits: EdgeEdits) -> int:
+        """Durably stage one batch without minting a version.
+
+        The batch is validated, appended (CRC-framed, fsync'd) to the WAL
+        chained on the current snapshot's fingerprint, and staged; the
+        next :meth:`apply` folds every staged batch into ONE new version,
+        so swaps amortize over many small edits (ROADMAP item 3). With no
+        ``wal_dir`` the queue still works — it just isn't durable.
+        Returns the pending-batch count."""
+        with self._lock:
+            head = self._snaps[-1]
+        edits.validate(head.delta.base.nv)
+        with spans.span("snapshot.enqueue"):
+            # The WAL append and the stage are one critical section under
+            # the store lock: an apply() draining the queue concurrently
+            # must not commit between our append and our stage, or the
+            # log would chain a batch onto a fingerprint it never saw.
+            with self._lock:
+                if self._wal is not None:
+                    self._wal.append_edits(edits, self._snaps[-1].fingerprint)
+                self._pending.append(edits)
+                return len(self._pending)
+
+    def apply(self, edits: Optional[EdgeEdits] = None,
               on_compact: Optional[Callable[[Snapshot], None]] = None
               ) -> Snapshot:
-        """Stack ``edits`` on the current version and mint version N+1.
+        """Fold ``edits`` plus every enqueued batch into version N+1.
+
+        WAL-before-mint: ``edits`` goes through :meth:`enqueue` first, so
+        by the time a version exists its batches are already durable; the
+        mint is then sealed with a fingerprinted ``commit`` record.
+        ``apply(None)`` flushes the queue alone (no-op if empty).
 
         Compaction past LUX_DELTA_COMPACT_RATIO runs on a background
         thread (adopting the caller's trace id so the swap's trace covers
         it); ``on_compact`` fires after it finishes.
         """
+        if edits is not None:
+            self.enqueue(edits)
         with spans.span("snapshot.apply") as tid:
             with self._lock:
                 head = self._snaps[-1]
-                snap = Snapshot(head.version + 1, head.delta.stack(edits))
+                if not self._pending:
+                    return head
+                batches, self._pending = self._pending, []
+                delta = head.delta
+                for e in batches:
+                    delta = delta.stack(e)
+                snap = Snapshot(head.version + 1, delta)
                 self._snaps.append(snap)
+                if self._wal is not None:
+                    # Fingerprint forces materialization; the store lock
+                    # is held so the commit serializes against enqueue's
+                    # chain read (see enqueue). Swaps already pay the
+                    # merge here — the warm path needs the graph anyway.
+                    self._wal.append_commit(snap.version, snap.fingerprint)
             if snap.ratio > flags.get_float("LUX_DELTA_COMPACT_RATIO"):
                 t = threading.Thread(
                     target=self._compact_one, args=(snap, tid, on_compact),

@@ -12,9 +12,11 @@ stdlib JSON front end: ``python -m lux_tpu.serve.http -file g.lux``.
 """
 
 from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
 from lux_tpu.serve.errors import (
     BadQueryError,
+    CircuitOpenError,
     DeadlineExceededError,
     QueueFullError,
     ServeError,
@@ -30,9 +32,11 @@ __all__ = [
     "ResultCache",
     "MicroBatcher",
     "Request",
+    "CircuitBreaker",
     "ServeError",
     "QueueFullError",
     "DeadlineExceededError",
     "BadQueryError",
     "SnapshotSwapError",
+    "CircuitOpenError",
 ]

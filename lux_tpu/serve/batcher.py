@@ -26,6 +26,7 @@ from typing import Any, Callable, Hashable, List, Optional
 
 from lux_tpu.obs import flight, metrics, spans
 from lux_tpu.serve.errors import DeadlineExceededError, QueueFullError
+from lux_tpu.utils import faults
 
 # Batch sizes are small integers; the seconds-oriented default bucket
 # bounds would collapse them into two buckets.
@@ -188,6 +189,10 @@ class MicroBatcher:
                 with spans.span("serve.batch", app=live[0].app,
                                 size=len(live)):
                     try:
+                        # Inside the fail-the-batch guard: an injected
+                        # raise here resolves every future (terminal
+                        # status), never kills the worker thread.
+                        faults.point("batcher.assemble")
                         self._execute(live)
                     except Exception as e:  # engine bug: fail the batch, keep serving
                         flight.dump("engine_exception", detail=repr(e))

@@ -14,6 +14,7 @@ from collections import OrderedDict
 from typing import Any, Hashable, Optional
 
 from lux_tpu.obs import metrics, spans
+from lux_tpu.utils import faults
 from lux_tpu.utils.locks import make_lock
 
 
@@ -47,6 +48,7 @@ class ResultCache:
 
     def put(self, key: Hashable, value: Any) -> None:
         with spans.span("serve.cache.put"):
+            faults.point("cache.put")
             with self._lock:
                 self._d[key] = value
                 self._d.move_to_end(key)

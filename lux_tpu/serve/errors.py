@@ -9,9 +9,14 @@ from __future__ import annotations
 
 
 class ServeError(Exception):
-    """Base class for serving failures."""
+    """Base class for serving failures.
+
+    ``retry_after_s`` (when not None) is surfaced by serve/http.py as a
+    ``Retry-After`` header so well-behaved clients back off instead of
+    hammering an overloaded or tripped server."""
 
     http_status = 500
+    retry_after_s = None
 
 
 class QueueFullError(ServeError):
@@ -21,6 +26,7 @@ class QueueFullError(ServeError):
     instead of queueing unboundedly (HTTP 429)."""
 
     http_status = 429
+    retry_after_s = 1.0
 
 
 class DeadlineExceededError(ServeError):
@@ -28,6 +34,7 @@ class DeadlineExceededError(ServeError):
     batch it rode in missed it); HTTP 504."""
 
     http_status = 504
+    retry_after_s = 1.0
 
 
 class BadQueryError(ServeError):
@@ -43,3 +50,17 @@ class SnapshotSwapError(ServeError):
     not half-applied; the client may retry (HTTP 503)."""
 
     http_status = 503
+    retry_after_s = 2.0
+
+
+class CircuitOpenError(ServeError):
+    """The circuit breaker for this (program, fingerprint) is open: the
+    engine failed ``LUX_BREAKER_THRESHOLD`` consecutive times and is
+    being rebuilt/probed in the background. Shed with 503 + Retry-After
+    instead of burning the batcher on an executor known to be bad."""
+
+    http_status = 503
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s

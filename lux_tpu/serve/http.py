@@ -24,10 +24,18 @@ Endpoints:
   graphs take ``[u, v, w]`` inserts) applies the batch and hot-swaps
   serving onto version N+1 (serve/session.py ``apply_edits``); the old
   version drains and keeps answering throughout. 503 when warmup of the
-  new version times out (the old version keeps serving).
+  new version times out (the old version keeps serving). Add
+  ``"queue": true`` to durably enqueue behind the WAL without swapping,
+  or send ``{"flush": true}`` alone to fold the queue / retry an
+  aborted swap (serve/session.py ``enqueue_edits``/``flush_edits``).
 
 Every JSON response carries ``X-Lux-Snapshot: <serving version>`` so
-clients can observe a hot-swap from response headers alone.
+clients can observe a hot-swap from response headers alone, and is
+counted into ``lux_requests_total{code=...}``. Degraded serving (a
+failed N+1 warm; version N still answering) adds ``X-Lux-Degraded``
+with the version that failed; shed responses (429/503/504) carry
+``Retry-After`` seconds from the error taxonomy (serve/errors.py) or
+the circuit breaker's cooldown remainder (serve/breaker.py).
 
 Every ``POST /query`` runs under a root request span (obs/spans.py):
 the response carries the trace-id in ``X-Lux-Trace``, and the same id
@@ -108,15 +116,29 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def _reply(self, status: int, payload: dict,
-               trace_id: str = None):
+               trace_id: str = None, retry_after: float = None):
         body = json.dumps(payload).encode()
+        # Counted HERE and only here, so every terminal status — success,
+        # shed, breaker-open, handler bug — lands in one per-code series
+        # (the chaos harness sums these against requests issued).
+        metrics.counter("lux_requests_total", {"code": str(status)}).inc()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if trace_id:
             self.send_header("X-Lux-Trace", trace_id)
+        if retry_after is not None:
+            # Shed responses (429/503/504) tell clients when to come
+            # back instead of letting them hammer a known-bad window.
+            self.send_header("Retry-After", f"{max(0.0, retry_after):.3f}")
         if self.session is not None:
             self.send_header("X-Lux-Snapshot", str(self.session.version))
+            degraded = self.session.degraded
+            if degraded is not None:
+                # Stale-while-revalidate marker: the served version is
+                # live but a newer one failed to warm (serve/session.py).
+                self.send_header("X-Lux-Degraded",
+                                 str(degraded.get("failed_version")))
         self.end_headers()
         self.wfile.write(body)
 
@@ -193,7 +215,7 @@ class _Handler(BaseHTTPRequestHandler):
             except ServeError as e:
                 self._reply(e.http_status, {
                     "error": str(e), "kind": type(e).__name__,
-                }, trace_id=tid)
+                }, trace_id=tid, retry_after=e.retry_after_s)
             except json.JSONDecodeError as e:
                 self._reply(400, {"error": f"bad JSON: {e}",
                                   "kind": "BadQueryError"}, trace_id=tid)
@@ -213,6 +235,13 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.loads(self.rfile.read(n) or b"{}")
                 if not isinstance(body, dict):
                     raise BadQueryError("body must be a JSON object")
+                if body.get("flush") and not (body.get("insert")
+                                              or body.get("delete")):
+                    # Revalidate / coalesce: fold whatever is queued (or
+                    # retry an aborted swap) without new edits.
+                    self._reply(200, self.session.flush_edits(),
+                                trace_id=tid)
+                    return
                 try:
                     edits = EdgeEdits.from_lists(
                         insert=body.get("insert", ()),
@@ -220,12 +249,17 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 except (TypeError, ValueError, IndexError) as e:
                     raise BadQueryError(f"bad edit batch: {e}")
-                summary = self.session.apply_edits(edits)
+                if body.get("queue"):
+                    # WAL-backed write-behind: durable immediately,
+                    # swapped on the next flush (ROADMAP item 3).
+                    summary = self.session.enqueue_edits(edits)
+                else:
+                    summary = self.session.apply_edits(edits)
                 self._reply(200, summary, trace_id=tid)
             except ServeError as e:
                 self._reply(e.http_status, {
                     "error": str(e), "kind": type(e).__name__,
-                }, trace_id=tid)
+                }, trace_id=tid, retry_after=e.retry_after_s)
             except json.JSONDecodeError as e:
                 self._reply(400, {"error": f"bad JSON: {e}",
                                   "kind": "BadQueryError"}, trace_id=tid)

@@ -21,7 +21,7 @@ from typing import Callable, Hashable
 
 from lux_tpu.analysis.sentinel import RecompileSentinel
 from lux_tpu.obs import metrics, spans
-from lux_tpu.utils import flags
+from lux_tpu.utils import faults, flags
 from lux_tpu.utils.locks import make_lock
 
 
@@ -57,6 +57,7 @@ class EnginePool:
             # their own.
             with spans.span("serve.engine_build", key=str(key)):
                 with self.sentinel.expect(key):
+                    faults.point("pool.build")
                     ex = factory()
                     if hasattr(ex, "warmup"):
                         # First-build warmup deliberately holds the lock:
@@ -81,7 +82,8 @@ class EnginePool:
         from lux_tpu.analysis import ir
         try:
             findings = ir.audit_engine(ex, f"pool@{key}")
-        except Exception:  # audit must never take down a build
+        # luxlint: disable=LUX007 -- advisory audit: a failed lowering must never take down a build
+        except Exception:
             return
         for f in findings:
             self._ir_findings.inc()

@@ -32,6 +32,7 @@ retire N's engines and evict its cache entries without failing anyone.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 import time
 from concurrent.futures import Future
@@ -43,11 +44,12 @@ from lux_tpu.graph.graph import Graph
 from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
 from lux_tpu.obs import flight, metrics, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
 from lux_tpu.serve.errors import (BadQueryError, QueueFullError,
-                                  SnapshotSwapError)
+                                  ServeError, SnapshotSwapError)
 from lux_tpu.serve.pool import EnginePool
-from lux_tpu.utils import flags
+from lux_tpu.utils import faults, flags
 from lux_tpu.utils.locks import make_lock
 from lux_tpu.utils.logging import get_logger
 
@@ -84,21 +86,29 @@ class Session:
 
     def __init__(
         self,
-        graph: Union[Graph, str],
+        graph: Union[Graph, str, SnapshotStore],
         config: Optional[ServeConfig] = None,
         warm: bool = True,
     ):
         self.log = get_logger("serve")
         self.config = config or ServeConfig()
         self.graph_path: Optional[str] = None
-        if isinstance(graph, str):
-            from lux_tpu.native import io as native_io
+        if isinstance(graph, SnapshotStore):
+            # Crash recovery: serve a store rebuilt by
+            # SnapshotStore.recover(base, wal_dir) as-is.
+            self.store = graph
+        else:
+            if isinstance(graph, str):
+                from lux_tpu.native import io as native_io
 
-            self.graph_path = graph
-            graph = native_io.read_lux(graph)
-        self.store = SnapshotStore(graph)
+                self.graph_path = graph
+                graph = native_io.read_lux(graph)
+            self.store = SnapshotStore(graph,
+                                       wal_dir=flags.get("LUX_WAL_DIR"))
         self._serving = self.store.current()  # luxlint: publish=_swap_lock
+        self._degraded = None  # luxlint: publish=_swap_lock
         self._swap_lock = make_lock("session.swap")
+        self.breaker = CircuitBreaker(self._breaker_probe)
         self.pool = EnginePool()
         self.cache = ResultCache(self.config.cache_capacity)
         self.batcher = MicroBatcher(
@@ -131,6 +141,14 @@ class Session:
     @property
     def version(self) -> int:
         return self._serving.version
+
+    @property
+    def degraded(self) -> Optional[dict]:
+        """Non-None while the session serves stale: the last attempt to
+        warm version N+1 failed, so version N keeps answering (HTTP
+        responses carry ``X-Lux-Degraded``). Cleared by the next
+        successful swap."""
+        return self._degraded
 
     # -- engines ---------------------------------------------------------
 
@@ -206,6 +224,7 @@ class Session:
         it stays flat across the query phase."""
         snap = snap or self._serving
         with spans.span("serve.warmup", version=snap.version):
+            faults.point("snapshot.warm")
             with _timed(self.log, "warmup sssp single"):
                 self._sssp_single(snap)
             with _timed(self.log, "warmup sssp multi"):
@@ -259,12 +278,17 @@ class Session:
         # so a hot-swap mid-request can never mix versions.
         snap = self._serving
         try:
+            # Shed instantly while this (app, fingerprint)'s breaker is
+            # open — no queue slot, no batcher time for an engine known
+            # to be failing (503 + Retry-After upstream).
+            self.breaker.check((app, snap.fingerprint))
             if app == "sssp":
                 fut = self._submit_sssp(params, deadline, snap)
             elif app == "components":
                 fut = self._submit_cached_fixpoint(
                     app, ("components",),
-                    lambda: self._run_components(snap), deadline, snap,
+                    lambda dl=None: self._run_components(snap, dl),
+                    deadline, snap,
                 )
             else:
                 ni = int(params.get("ni", self.config.pagerank_iters))
@@ -274,7 +298,8 @@ class Session:
                     )
                 fut = self._submit_cached_fixpoint(
                     app, ("pagerank", ni),
-                    lambda: self._run_pagerank(ni, snap), deadline, snap,
+                    lambda dl=None: self._run_pagerank(ni, snap, dl),
+                    deadline, snap,
                 )
         except BaseException:
             if token is not None:
@@ -355,6 +380,56 @@ class Session:
             # luxlint: disable=LUX301 -- _watched only runs on the batcher thread
             self._served_keys.add(key)
 
+    def _engine_execute(self, app: str, snap: Snapshot, key, deadline, fn):
+        """One engine execution with fault injection, bounded
+        retry-with-backoff, and circuit-breaker accounting.
+
+        Transient (non-ServeError) failures retry up to LUX_RETRY_MAX
+        times with doubling LUX_RETRY_BACKOFF_MS backoff, clamped by the
+        batch's deadline — a retry that could not start before the
+        deadline fails now instead of burning engine time on an answer
+        nobody is waiting for. Terminal failures feed the breaker for
+        ``(app, fingerprint)``; successes reset it."""
+        bkey = (app, snap.fingerprint)
+        attempts = 1 + max(0, flags.get_int("LUX_RETRY_MAX"))
+        backoff_s = max(0.0, flags.get_float("LUX_RETRY_BACKOFF_MS")) / 1e3
+        for attempt in range(1, attempts + 1):
+            try:
+                with self._watched(key):
+                    faults.point("serve.engine.execute")
+                    out = fn()
+            except ServeError:
+                raise             # shed/typed errors are not engine faults
+            except Exception as e:
+                exhausted = attempt >= attempts or (
+                    deadline is not None
+                    and spans.monotonic() + backoff_s > deadline)
+                if exhausted:
+                    self.breaker.record_failure(bkey, error=e)
+                    raise
+                metrics.counter("lux_serve_retries_total",
+                                {"app": app}).inc()
+                self.log.warning(
+                    "engine %s attempt %d/%d failed (%r); retrying in "
+                    "%d ms", app, attempt, attempts, e,
+                    int(backoff_s * 1e3))
+                time.sleep(backoff_s)
+                backoff_s *= 2
+            else:
+                self.breaker.record_success(bkey)
+                return out
+
+    def _cache_put(self, key, value) -> None:
+        """Cache insert that degrades instead of failing the request: a
+        computed answer is never thrown away because the cache hiccuped
+        (serving correctness never depends on the cache — a failed put
+        only costs a future recompute)."""
+        try:
+            self.cache.put(key, value)
+        except Exception as e:
+            metrics.counter("lux_serve_cache_put_errors_total").inc()
+            self.log.warning("cache put failed for %r: %r", key, e)
+
     def _execute_batch(self, batch: List[Request]):
         if batch[0].app == "sssp":
             self._execute_sssp_batch(batch)
@@ -370,55 +445,111 @@ class Session:
         (key, run) = batch[0].payload
         hit = self.cache.get(key)   # raced submits may have filled it
         if hit is None:
-            hit = run()
-            self.cache.put(key, hit)
+            hit = run(batch[0].deadline)
+            self._cache_put(key, hit)
         batch[0].future.set_result(hit)
 
     def _execute_sssp_batch(self, batch: List[Request]):
         snap = batch[0].payload[0]   # batch_key pins one snapshot per batch
         roots = [r.payload[1] for r in batch]
+        # A retry must respect the tightest deadline riding the batch.
+        deadlines = [r.deadline for r in batch if r.deadline is not None]
+        deadline = min(deadlines) if deadlines else None
         if len(batch) == 1:
             key = self._engine_key("push", snap, ("sssp", 1))
             ex = self._sssp_single(snap)
-            with self._watched(key), spans.span(
-                    "serve.engine", app="sssp", engine="push", lanes=1):
-                state, iters = ex.run(start=roots[0])
-                results = [np.asarray(state.values)]
+
+            def run_engine():
+                with spans.span("serve.engine", app="sssp", engine="push",
+                                lanes=1):
+                    state, iters = ex.run(start=roots[0])
+                    return [np.asarray(state.values)], int(iters)
         else:
             key = self._engine_key(
                 "push_multi", snap, ("sssp", self.config.max_batch)
             )
             ex = self._sssp_multi(snap)
-            with self._watched(key), spans.span(
-                    "serve.engine", app="sssp", engine="push_multi",
-                    lanes=len(roots)):
-                state, iters = ex.run(roots)
-                results = [
-                    ex.values_for(state, j) for j in range(len(roots))
-                ]
+
+            def run_engine():
+                with spans.span("serve.engine", app="sssp",
+                                engine="push_multi", lanes=len(roots)):
+                    state, iters = ex.run(roots)
+                    return [
+                        ex.values_for(state, j) for j in range(len(roots))
+                    ], int(iters)
+        results, iters = self._engine_execute(
+            "sssp", snap, key, deadline, run_engine)
         for r, root, vals in zip(batch, roots, results):
-            out = {"values": vals, "iters": int(iters), "start": root}
-            self.cache.put((snap.fingerprint, "sssp", root), out)
+            out = {"values": vals, "iters": iters, "start": root}
+            self._cache_put((snap.fingerprint, "sssp", root), out)
             r.future.set_result(out)
 
-    def _run_components(self, snap: Snapshot) -> dict:
+    def _run_components(self, snap: Snapshot,
+                        deadline: Optional[float] = None) -> dict:
         ex = self._components_engine(snap)
-        with self._watched(
-                self._engine_key("push", snap, ("components", 1))), \
-                spans.span("serve.engine", app="components",
-                           engine="push"):
-            state, iters = ex.run()
-        return {"values": np.asarray(state.values), "iters": int(iters)}
+        key = self._engine_key("push", snap, ("components", 1))
 
-    def _run_pagerank(self, ni: int, snap: Snapshot) -> dict:
+        def run_engine():
+            with spans.span("serve.engine", app="components",
+                            engine="push"):
+                state, iters = ex.run()
+                return {"values": np.asarray(state.values),
+                        "iters": int(iters)}
+
+        return self._engine_execute("components", snap, key, deadline,
+                                    run_engine)
+
+    def _run_pagerank(self, ni: int, snap: Snapshot,
+                      deadline: Optional[float] = None) -> dict:
         from lux_tpu.models.cli import final_values
 
         ex = self._pagerank_engine(snap)
-        with self._watched(self._engine_key("pull", snap, ("pagerank",))), \
-                spans.span("serve.engine", app="pagerank", engine="pull",
-                           iters=ni):
-            vals = ex.run(ni)
-        return {"values": final_values(ex, vals), "iters": ni}
+        key = self._engine_key("pull", snap, ("pagerank",))
+
+        def run_engine():
+            with spans.span("serve.engine", app="pagerank", engine="pull",
+                            iters=ni):
+                vals = ex.run(ni)
+                return {"values": final_values(ex, vals), "iters": ni}
+
+        return self._engine_execute("pagerank", snap, key, deadline,
+                                    run_engine)
+
+    # -- circuit-breaker probe ---------------------------------------------
+
+    def _breaker_probe(self, bkey) -> bool:
+        """Half-open probe (background thread): rebuild the tripped
+        program's pool entry and prove ONE execution before the breaker
+        closes and traffic returns. Runs under the sentinel's expect —
+        rebuild compiles are warmup, and the probe's run reaches any
+        lazily-jitted runner so post-probe serving stays recompile-free."""
+        app, fp = bkey
+        snap = self._serving
+        if snap.fingerprint != fp:
+            return True   # that snapshot swapped away; nothing to rebuild
+        with spans.span("serve.breaker_probe", app=app):
+            if app == "sssp":
+                key = self._engine_key("push", snap, ("sssp", 1))
+                self.pool.retire(lambda k: k == key)
+                ex = self._sssp_single(snap)
+                with self.pool.sentinel.expect(("probe",) + key):
+                    faults.point("serve.engine.execute")
+                    ex.run(start=0)
+            elif app == "components":
+                key = self._engine_key("push", snap, ("components", 1))
+                self.pool.retire(lambda k: k == key)
+                ex = self._components_engine(snap)
+                with self.pool.sentinel.expect(("probe",) + key):
+                    faults.point("serve.engine.execute")
+                    ex.run()
+            else:
+                key = self._engine_key("pull", snap, ("pagerank",))
+                self.pool.retire(lambda k: k == key)
+                ex = self._pagerank_engine(snap)
+                with self.pool.sentinel.expect(("probe",) + key):
+                    faults.point("serve.engine.execute")
+                    ex.run(1)
+        return True
 
     # -- snapshot hot-swap -----------------------------------------------
 
@@ -433,7 +564,9 @@ class Session:
         2. N+1's engines build + compile on a background warm thread,
            bounded by LUX_SNAPSHOT_WARM_TIMEOUT — on timeout or error the
            swap aborts with :class:`SnapshotSwapError` and N keeps
-           serving;
+           serving *degraded* (see :attr:`degraded`; N+1 stays minted
+           and durable — retry with :meth:`flush_edits`, never by
+           re-sending the same edits);
         3. with LUX_INCREMENTAL, cached components/SSSP fixpoints are
            refreshed by warm-started incremental runs and stored under
            N+1's fingerprint *before* the flip (PageRank entries are
@@ -446,15 +579,65 @@ class Session:
            version-N work, then retires N's engines and evicts its cache
            entries — zero failed in-flight queries by construction.
 
+        With a WAL armed (LUX_WAL_DIR), ``edits`` is appended (CRC-framed,
+        fsync'd) *before* version N+1 is minted, so a crash anywhere in
+        the swap loses nothing: :meth:`SnapshotStore.recover` replays the
+        log to the exact minted state.
+
         Returns a summary dict (versions, fingerprints, eviction counts,
         incremental-refresh counts, timings).
         """
         from lux_tpu.graph.delta import EdgeEdits
 
+        if not isinstance(edits, EdgeEdits):
+            raise BadQueryError("apply_edits takes an EdgeEdits batch")
+        return self._swap_entry(edits, edits, warm_timeout)
+
+    def enqueue_edits(self, edits) -> dict:
+        """Durably queue one batch behind the WAL *without* swapping.
+
+        ROADMAP item 3's write-ahead queue: many small batches coalesce
+        and the next :meth:`flush_edits` (or ``apply_edits``) folds them
+        into ONE hot-swap — one warm, one flip, one drain. Auto-flushes
+        once LUX_EDIT_QUEUE_MAX batches are pending."""
+        from lux_tpu.graph.delta import EdgeEdits
+
         if self._closed:
             raise BadQueryError("session is closed")
         if not isinstance(edits, EdgeEdits):
-            raise BadQueryError("apply_edits takes an EdgeEdits batch")
+            raise BadQueryError("enqueue_edits takes an EdgeEdits batch")
+        try:
+            pending = self.store.enqueue(edits)
+        except ValueError as e:
+            raise BadQueryError(str(e)) from None
+        metrics.gauge("lux_serve_pending_edits").set(pending)
+        if pending >= max(1, flags.get_int("LUX_EDIT_QUEUE_MAX")):
+            return self.flush_edits()
+        return {"queued": True, "pending": pending,
+                "version": self.version}
+
+    def flush_edits(self, warm_timeout: Optional[float] = None) -> dict:
+        """Fold every enqueued batch into one hot-swap (no-op if none).
+
+        Incremental cache refresh applies when exactly one batch is
+        pending (the refresh needs the batch's edge lists); multi-batch
+        flushes degrade to evict-only, which is always correct.
+
+        This is also the *revalidate* half of stale-while-revalidate:
+        after an aborted swap the minted version is still the store head
+        (its edits are durable), so a flush with an empty queue re-warms
+        and flips onto it rather than re-applying anything."""
+        batches = self.store.pending_batches()
+        if not batches and self.store.current().version == self.version:
+            return {"queued": False, "pending": 0, "version": self.version,
+                    "noop": True}
+        refresh = batches[0] if len(batches) == 1 else None
+        return self._swap_entry(None, refresh, warm_timeout)
+
+    def _swap_entry(self, edits, refresh_edits,
+                    warm_timeout: Optional[float]) -> dict:
+        if self._closed:
+            raise BadQueryError("session is closed")
         if warm_timeout is None:
             warm_timeout = flags.get_float("LUX_SNAPSHOT_WARM_TIMEOUT")
         with self._swap_lock:
@@ -468,7 +651,8 @@ class Session:
             try:
                 with spans.span("serve.snapshot_swap",
                                 old_version=old.version):
-                    summary = self._swap(old, edits, warm_timeout, t_swap0)
+                    summary = self._swap(old, edits, refresh_edits,
+                                         warm_timeout, t_swap0)
             finally:
                 if token is not None:
                     spans.deactivate(token)
@@ -476,12 +660,17 @@ class Session:
                     finish()
             return summary
 
-    def _swap(self, old: Snapshot, edits, warm_timeout: float,
-              t_swap0: float) -> dict:
+    def _swap(self, old: Snapshot, edits, refresh_edits,
+              warm_timeout: float, t_swap0: float) -> dict:
         try:
             snap = self.store.apply(edits)
         except ValueError as e:
             raise BadQueryError(str(e)) from None
+        metrics.gauge("lux_serve_pending_edits").set(0)
+        if snap.version == old.version:
+            # flush_edits raced another flush; the queue was empty.
+            return {"queued": False, "pending": 0, "version": old.version,
+                    "noop": True}
 
         # Warm version N+1's engines off-thread so a stuck compile can't
         # wedge the session; the sentinel sees the builds as expected
@@ -506,6 +695,12 @@ class Session:
         warm_thread.start()
         warm_thread.join(warm_timeout)
         warm_s = spans.clock() - t_warm0
+        if warm_err and isinstance(warm_err[0], faults.CrashPoint):
+            # An injected crash is process death, not a degradable
+            # failure: re-raise it past every handler (BaseException) so
+            # the harness exercises WAL recovery. The edits are already
+            # durable — logged and committed before the warm started.
+            raise warm_err[0]
         if warm_thread.is_alive() or warm_err:
             metrics.counter("lux_snapshot_aborts_total").inc()
             why = (f"warmup timed out after {warm_timeout:.1f}s"
@@ -513,17 +708,33 @@ class Session:
                    else f"warmup failed: {warm_err[0]!r}")
             self.log.error("snapshot swap v%d -> v%d aborted: %s",
                            old.version, snap.version, why)
+            self._mark_degraded(why, old, snap)
+            flight.dump("snapshot_swap_aborted", detail=why)
             raise SnapshotSwapError(
                 f"snapshot v{snap.version} not swapped in ({why}); "
                 f"v{old.version} still serving"
             )
 
         refreshed = None
-        if flags.get_bool("LUX_INCREMENTAL"):
-            refreshed = self._incremental_refresh(old, snap, edits)
+        if flags.get_bool("LUX_INCREMENTAL") and refresh_edits is not None:
+            try:
+                refreshed = self._incremental_refresh(old, snap,
+                                                      refresh_edits)
+            except Exception as e:
+                # The refresh is an optimization over evict-and-recompute;
+                # a minted, durable version must not be abandoned because
+                # warm-starting caches failed. Degrade to evict-only.
+                metrics.counter("lux_serve_refresh_errors_total").inc()
+                flight.dump("incremental_refresh_failed", detail=repr(e))
+                self.log.warning(
+                    "incremental refresh v%d failed (%r); serving "
+                    "evict-only", snap.version, e)
+                refreshed = None
 
         # The atomic flip: requests admitted after this line bind to N+1.
         self._serving = snap  # luxlint: guarded-by=_swap_lock -- apply_edits holds it
+        self._degraded = None  # luxlint: guarded-by=_swap_lock -- _swap_entry holds it
+        metrics.gauge("lux_serve_degraded").set(0.0)
         metrics.gauge("lux_snapshot_version").set(float(snap.version))
         metrics.counter("lux_snapshot_applies_total").inc()
 
@@ -549,6 +760,16 @@ class Session:
             "refreshed": refreshed,
             **drained,
         }
+
+    def _mark_degraded(self, why: str, old: Snapshot,
+                       snap: Snapshot) -> None:
+        """Stale-while-revalidate: ``old`` keeps serving, responses grow
+        an X-Lux-Degraded header until a later swap lands."""
+        self._degraded = {  # luxlint: guarded-by=_swap_lock -- _swap holds it
+            "reason": why, "stale_version": old.version,
+            "failed_version": snap.version, "since": spans.clock(),
+        }
+        metrics.gauge("lux_serve_degraded").set(1.0)
 
     def _drain_behind(self, old: Snapshot) -> dict:
         """Ride a barrier through the FIFO batcher behind every remaining
@@ -618,7 +839,7 @@ class Session:
                         cc_hit["values"], removed=removed,
                         inserted=inserted,
                     )
-                self.cache.put(
+                self._cache_put(
                     (snap.fingerprint, "components"),
                     {"values": np.asarray(state.values),
                      "iters": int(iters), "incremental": True},
@@ -654,7 +875,7 @@ class Session:
                             inserted=inserted,
                         )
                     for j, r in enumerate(lane_roots):
-                        self.cache.put(
+                        self._cache_put(
                             (snap.fingerprint, "sssp", r),
                             {"values": multi.values_for(state, j),
                              "iters": int(iters), "start": r,
@@ -675,6 +896,8 @@ class Session:
             "delta_ratio": round(snap.ratio, 6),
             "compacted": snap.compacted,
             "history": self.store.history(),
+            "pending_edits": self.store.pending_edits(),
+            "wal": self.store.wal_stats(),
         }
 
     # -- introspection / lifecycle ---------------------------------------
@@ -711,7 +934,13 @@ class Session:
         return {
             "windows": self.slo.snapshot(),
             "snapshot": {"version": self.version,
-                         "fingerprint": self.fingerprint},
+                         "fingerprint": self.fingerprint,
+                         "pending_edits": self.store.pending_edits()},
+            "breaker": self.breaker.stats(),
+            "degraded": self._degraded,
+            "faults": {"armed": [dataclasses.asdict(r)
+                                 for r in faults.armed()],
+                       "injected": faults.counts()},
             "queue": {"depth": b["queue_depth"],
                       "capacity": b["queue_capacity"]},
             "cache_hit_rate": (c["hits"] / probes) if probes else None,
@@ -737,6 +966,8 @@ class Session:
             "cache": self.cache.stats(),
             "batcher": self.batcher.stats(),
             "sentinel": self.pool.sentinel.stats(),
+            "breaker": self.breaker.stats(),
+            "degraded": self._degraded,
         }
 
     def close(self):
@@ -744,6 +975,7 @@ class Session:
             self._closed = True
             flight.remove_context(self._flight_name)
             self.batcher.close()
+            self.breaker.drain_probes()
             self.pool.close()
             self.store.drain_compactions()
 

@@ -244,6 +244,38 @@ define("LUX_INCREMENTAL", True,
        "snapshot's values during a hot-swap instead of recomputing on "
        "demand (0 = evict only)", kind="bool")
 
+# Robustness: fault injection (utils/faults.py), edit WAL (graph/wal.py),
+# graceful degradation (serve/session.py, serve/breaker.py)
+define("LUX_FAULTS", None,
+       "fault-injection spec `point:kind:prob[:arg]`, comma-separated "
+       "(kinds: raise|delay_ms|corrupt|crash; see utils/faults.py); "
+       "unset/empty = disarmed, the points cost one bool check")
+define("LUX_FAULTS_SEED", 0,
+       "seed for the per-rule fault-injection RNGs (utils/faults.py)",
+       kind="int")
+define("LUX_WAL_DIR", None,
+       "directory for the edit write-ahead log; when set, Session edits "
+       "are CRC-framed + fsync'd to <dir>/lux.wal before any version is "
+       "minted, and SnapshotStore.recover replays it on startup (unset = "
+       "no durability, the pre-WAL behavior)", kind="path")
+define("LUX_EDIT_QUEUE_MAX", 8,
+       "Session.enqueue_edits auto-flushes the WAL-backed edit queue "
+       "into one hot-swap once this many batches are pending (ROADMAP "
+       "item 3: swaps amortize over many small edits)", kind="int")
+define("LUX_RETRY_MAX", 2,
+       "max engine re-executions after a transient (non-ServeError) "
+       "failure per batch, clamped by the request deadline (0 = fail "
+       "fast)", kind="int")
+define("LUX_RETRY_BACKOFF_MS", 25.0,
+       "initial retry backoff in ms, doubling per attempt", kind="float")
+define("LUX_BREAKER_THRESHOLD", 5,
+       "consecutive engine failures on one (app, fingerprint) before the "
+       "circuit breaker opens and sheds that program with 503 + "
+       "Retry-After", kind="int")
+define("LUX_BREAKER_COOLDOWN_MS", 2000.0,
+       "ms an open breaker waits before going half-open and probing the "
+       "rebuilt engine in the background", kind="float")
+
 # Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
 define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")
 define("LUX_SMOKE_ITERS", 8, "obs_smoke PageRank iterations", kind="int")

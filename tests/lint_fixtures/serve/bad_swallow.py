@@ -1,0 +1,24 @@
+"""LUX007 fixtures: broad serve-path handlers that drop errors on the
+floor — the request waiting on the result never hears about them."""
+
+
+def drop_with_pass(engine):
+    try:
+        return engine.run()
+    except Exception:  # expect: LUX007
+        pass
+
+
+def log_and_drop(engine, log):
+    try:
+        return engine.run()
+    except:  # expect: LUX007
+        log.warning("engine failed; carrying on")
+
+
+def print_and_bail(engine):
+    try:
+        return engine.run()
+    except (ValueError, BaseException) as e:  # expect: LUX007
+        print("dropping", e)
+        return None

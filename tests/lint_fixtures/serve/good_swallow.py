@@ -1,0 +1,37 @@
+"""LUX007-clean handlers: broad catches stay legal while the failure
+remains observable (re-raised, typed, or resolved into a future)."""
+
+
+class WrappedError(Exception):
+    pass
+
+
+def rethrow_typed(engine):
+    try:
+        return engine.run()
+    except Exception as e:
+        raise WrappedError(f"engine failed: {e}") from e
+
+
+def fail_the_batch(batch):
+    try:
+        batch.execute()
+    except Exception as e:
+        for r in batch.requests:
+            r.future.set_exception(e)
+
+
+def record_then_degrade(engine, counters):
+    try:
+        return engine.run()
+    except Exception:
+        counters.cache_put_errors += 1
+        return engine.fallback()
+
+
+def narrow_catch_may_pass(value):
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    return 0

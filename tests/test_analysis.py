@@ -33,6 +33,7 @@ BAD_FIXTURES = (
     "ops/bad_kernel_specs.py",
     "lux_tpu/bad_envflag.py",
     "serve/bad_clock.py",
+    "serve/bad_swallow.py",
 )
 GOOD_FIXTURES = (
     "engine/good_host_sync.py",
@@ -40,6 +41,7 @@ GOOD_FIXTURES = (
     "ops/good_kernel_specs.py",
     "lux_tpu/good_envflag.py",
     "serve/good_clock.py",
+    "serve/good_swallow.py",
 )
 
 

@@ -1,0 +1,168 @@
+"""utils/faults: spec parsing, seeded determinism, fire budgets, the
+four kinds' semantics, and the zero-cost disarmed path."""
+
+import time
+
+import numpy as np
+import pytest
+
+from lux_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# -- parsing ---------------------------------------------------------------
+
+
+def test_parse_full_grammar():
+    rules = faults.parse(
+        "serve.engine.execute:raise:0.25,"
+        "wal.fsync:corrupt:1.0:2,"
+        "pool.build:delay_ms:0.5:20"
+    )
+    assert [(r.point, r.kind, r.prob, r.arg) for r in rules] == [
+        ("serve.engine.execute", "raise", 0.25, None),
+        ("wal.fsync", "corrupt", 1.0, 2.0),
+        ("pool.build", "delay_ms", 0.5, 20.0),
+    ]
+
+
+@pytest.mark.parametrize("spec, why", [
+    ("nope:raise:1.0", "unknown fault point"),
+    ("pool.build:explode:1.0", "unknown fault kind"),
+    ("pool.build:raise:1.5", "outside"),
+    ("pool.build:raise:x", "bad probability"),
+    ("pool.build:delay_ms:1.0", "delay_ms needs an arg"),
+    ("pool.build:raise", "want point:kind:prob"),
+    ("pool.build:raise:1.0:-3", "negative arg"),
+])
+def test_parse_rejects(spec, why):
+    with pytest.raises(ValueError, match=why):
+        faults.parse(spec)
+
+
+def test_parse_empty_spec_is_no_rules():
+    assert faults.parse("") == []
+    assert faults.parse(" , ") == []
+
+
+# -- firing ----------------------------------------------------------------
+
+
+def test_disarmed_point_is_identity():
+    data = b"payload"
+    assert faults.point("wal.fsync", data=data) is data
+    assert faults.point("serve.engine.execute") is None
+    assert faults.armed() == ()
+
+
+def test_unknown_point_name_fails_loudly_when_armed():
+    faults.arm("pool.build:raise:0.0")
+    # A typo'd lace site must not silently never fire: _fire looks the
+    # name up only among registered points, armed names come validated.
+    assert faults.point("pool.build") is None
+    faults.disarm()
+
+
+def test_raise_kind_is_transient_runtime_error():
+    faults.arm("serve.engine.execute:raise:1.0")
+    with pytest.raises(faults.FaultInjected) as ei:
+        faults.point("serve.engine.execute")
+    assert isinstance(ei.value, RuntimeError)
+    assert ei.value.point == "serve.engine.execute"
+
+
+def test_crash_kind_escapes_except_exception():
+    faults.arm("snapshot.warm:crash:1.0")
+    with pytest.raises(faults.CrashPoint):
+        try:
+            faults.point("snapshot.warm")
+        except Exception:   # must NOT absorb the crash
+            pytest.fail("CrashPoint was caught by `except Exception`")
+    assert not issubclass(faults.CrashPoint, Exception)
+
+
+def test_fire_budget_caps_injections():
+    faults.arm("serve.engine.execute:raise:1.0:2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjected):
+            faults.point("serve.engine.execute")
+    # Budget spent: the point goes quiet (transient-blip modeling).
+    for _ in range(5):
+        faults.point("serve.engine.execute")
+    assert faults.counts()["serve.engine.execute:raise"] >= 2
+
+
+def test_delay_kind_sleeps():
+    faults.arm("cache.put:delay_ms:1.0:30")
+    t0 = time.perf_counter()
+    faults.point("cache.put")
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_corrupt_returns_damaged_copy():
+    faults.arm("wal.fsync:corrupt:1.0")
+    data = bytes(range(64))
+    out = faults.point("wal.fsync", data=data)
+    assert out != data and len(out) == len(data)
+    assert data == bytes(range(64))     # original untouched
+
+    arr = np.arange(16, dtype=np.int64)
+    out = faults.point("wal.fsync", data=arr)
+    assert not np.array_equal(out, arr)
+    assert arr[8] == 8                  # copy, not in-place
+
+
+def test_seeded_determinism():
+    def draw(seed):
+        faults.arm("serve.engine.execute:raise:0.5", seed=seed)
+        fired = []
+        for _ in range(40):
+            try:
+                faults.point("serve.engine.execute")
+                fired.append(0)
+            except faults.FaultInjected:
+                fired.append(1)
+        return fired
+
+    a, b, c = draw(7), draw(7), draw(8)
+    assert a == b
+    assert a != c
+
+
+def test_injected_context_restores_previous_arming():
+    faults.arm("pool.build:raise:0.0")
+    before = faults.armed()
+    with faults.injected("cache.put:raise:1.0"):
+        assert {r.point for r in faults.armed()} == {"cache.put"}
+        with pytest.raises(faults.FaultInjected):
+            faults.point("cache.put")
+    assert faults.armed() == before
+
+
+def test_reconfigure_reads_env(monkeypatch):
+    monkeypatch.setenv("LUX_FAULTS", "batcher.assemble:raise:1.0")
+    assert faults.reconfigure() == 1
+    with pytest.raises(faults.FaultInjected):
+        faults.point("batcher.assemble")
+    monkeypatch.setenv("LUX_FAULTS", "")
+    assert faults.reconfigure() == 0
+    assert faults.point("batcher.assemble") is None
+
+
+def test_counts_and_metric_accounting():
+    from lux_tpu.obs import metrics
+    base = metrics.counter("lux_faults_injected_total",
+                           {"point": "pool.build", "kind": "raise"}).value
+    faults.arm("pool.build:raise:1.0:3")
+    for _ in range(3):
+        with pytest.raises(faults.FaultInjected):
+            faults.point("pool.build")
+    assert metrics.counter(
+        "lux_faults_injected_total",
+        {"point": "pool.build", "kind": "raise"}).value == base + 3

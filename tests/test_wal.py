@@ -1,0 +1,266 @@
+"""graph/wal: frame format, torn-write policy, fingerprint chaining,
+replay parity, compaction, and crash-point recovery through the store."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import DeltaGraph, EdgeEdits, generate
+from lux_tpu.graph.snapshot import SnapshotStore
+from lux_tpu.graph.wal import (MAGIC, RecoveryResult, Wal, WalCorruptError,
+                               read_records, replay)
+from lux_tpu.utils import checkpoint, faults
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _graph(seed=11):
+    return generate.gnp(120, 700, seed=seed)
+
+
+def _edits(g, seed, n=10):
+    rng = np.random.default_rng(seed)
+    ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+           for _ in range(n)]
+    eidx = rng.choice(g.ne, size=n // 2, replace=False)
+    dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    return EdgeEdits.from_lists(insert=ins, delete=dels)
+
+
+def _wal_path(d):
+    return os.path.join(str(d), "lux.wal")
+
+
+# -- append / read roundtrip ----------------------------------------------
+
+
+def test_append_and_read_roundtrip(tmp_path):
+    g = _graph()
+    fp = checkpoint.fingerprint_hex(g)
+    w = Wal(str(tmp_path))
+    e = _edits(g, 1)
+    assert w.append_edits(e, fp) == 1
+    assert w.append_commit(1, "f" * 64) == 2
+    recs, torn = read_records(w.path)
+    assert not torn
+    assert [r.kind for r in recs] == ["edits", "commit"]
+    assert recs[0].base_fp == fp
+    np.testing.assert_array_equal(recs[0].edits.ins_src, e.ins_src)
+    np.testing.assert_array_equal(recs[0].edits.del_dst, e.del_dst)
+    assert recs[1].version == 1 and recs[1].fingerprint == "f" * 64
+    assert w.stats()["records"] == 2
+
+
+def test_weighted_edits_roundtrip(tmp_path):
+    w = Wal(str(tmp_path))
+    e = EdgeEdits.from_lists(insert=[(0, 1, 7), (2, 3, 9)],
+                             delete=[(4, 5)])
+    w.append_edits(e, "a" * 64)
+    (rec,), _ = read_records(w.path)
+    np.testing.assert_array_equal(rec.edits.ins_w, e.ins_w)
+
+
+def test_reopen_resumes_sequence(tmp_path):
+    w = Wal(str(tmp_path))
+    w.append_edits(EdgeEdits.from_lists(insert=[(0, 1)]), "a" * 64)
+    w2 = Wal(str(tmp_path))
+    assert w2.append_commit(1, "b" * 64) == 2
+
+
+# -- torn-write policy -----------------------------------------------------
+
+
+def test_torn_final_record_is_truncated(tmp_path):
+    g = _graph()
+    w = Wal(str(tmp_path))
+    w.append_edits(_edits(g, 1), "a" * 64)
+    size_after_first = os.path.getsize(w.path)
+    w.append_edits(_edits(g, 2), "a" * 64)
+    # Tear the second frame mid-payload, as a crash mid-append would.
+    os.truncate(w.path, size_after_first + 9)
+    recs, torn = read_records(w.path)
+    assert torn and len(recs) == 1
+    # Re-opening repairs the file in place and appends cleanly after.
+    w2 = Wal(str(tmp_path))
+    assert os.path.getsize(w2.path) == size_after_first
+    w2.append_commit(1, "b" * 64)
+    recs, torn = read_records(w2.path)
+    assert not torn and [r.kind for r in recs] == ["edits", "commit"]
+
+
+def test_corrupt_final_record_counts_as_torn(tmp_path):
+    g = _graph()
+    w = Wal(str(tmp_path))
+    w.append_edits(_edits(g, 1), "a" * 64)
+    with open(w.path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    recs, torn = read_records(w.path)
+    assert torn and recs == []
+
+
+def test_crc_damage_before_final_record_raises(tmp_path):
+    g = _graph()
+    w = Wal(str(tmp_path))
+    w.append_edits(_edits(g, 1), "a" * 64)
+    w.append_commit(1, "b" * 64)
+    # Flip a byte inside the FIRST record's payload: interior rot, not a
+    # torn tail — replay must refuse rather than skip.
+    with open(w.path, "r+b") as f:
+        f.seek(len(MAGIC) + struct.calcsize("<II") + 40)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(WalCorruptError, match="CRC mismatch"):
+        read_records(w.path)
+
+
+def test_injected_corruption_is_crc_detectable(tmp_path):
+    g = _graph()
+    w = Wal(str(tmp_path))
+    with faults.injected("wal.fsync:corrupt:1.0:1"):
+        w.append_edits(_edits(g, 1), "a" * 64)   # written bytes are bad
+    w.append_commit(1, "b" * 64)                 # clean record after
+    # The CRC was computed pre-corruption, so the damaged record fails
+    # its checksum mid-file -> interior damage, loud failure.
+    with pytest.raises(WalCorruptError):
+        read_records(w.path)
+
+
+def test_bad_magic_raises(tmp_path):
+    p = _wal_path(tmp_path)
+    with open(p, "wb") as f:
+        f.write(b"NOTAWAL!" + b"\x00" * 32)
+    with pytest.raises(WalCorruptError, match="magic"):
+        read_records(p)
+
+
+# -- replay ----------------------------------------------------------------
+
+
+def test_replay_no_log_returns_base(tmp_path):
+    g = _graph()
+    r = replay(g, str(tmp_path))
+    assert isinstance(r, RecoveryResult)
+    assert r.graph is g and r.version == 0 and r.pending == ()
+
+
+def test_store_recovery_is_bitwise_identical(tmp_path):
+    g = _graph()
+    store = SnapshotStore(g, wal_dir=str(tmp_path))
+    e1, e2 = _edits(g, 1), _edits(g, 2)
+    store.apply(e1)
+    store.apply(e2)
+    head = store.current()
+    expect = DeltaGraph.fresh(g).stack(e1).merged()
+    expect = DeltaGraph.fresh(expect).stack(e2).merged()
+
+    recovered = SnapshotStore.recover(_graph(), str(tmp_path))
+    rhead = recovered.current()
+    assert rhead.version == head.version == 2
+    assert rhead.fingerprint == head.fingerprint
+    np.testing.assert_array_equal(rhead.graph.row_ptr, expect.row_ptr)
+    np.testing.assert_array_equal(rhead.graph.col_src, expect.col_src)
+
+
+def test_recovery_restages_uncommitted_batches(tmp_path):
+    g = _graph()
+    store = SnapshotStore(g, wal_dir=str(tmp_path))
+    store.apply(_edits(g, 1))
+    committed_fp = store.current().fingerprint
+    store.enqueue(_edits(g, 2))      # logged, never minted
+
+    recovered = SnapshotStore.recover(_graph(), str(tmp_path))
+    assert recovered.current().version == 1
+    assert recovered.current().fingerprint == committed_fp
+    assert recovered.pending_edits() == 1
+    # The next apply mints exactly what the dead process would have.
+    snap = recovered.apply()
+    assert snap.version == 2
+
+    fresh = SnapshotStore(_graph(), wal_dir=None)
+    fresh.apply(_edits(g, 1))
+    fresh.apply(_edits(g, 2))
+    assert snap.fingerprint == fresh.current().fingerprint
+
+
+def test_replay_wrong_base_raises(tmp_path):
+    g = _graph()
+    store = SnapshotStore(g, wal_dir=str(tmp_path))
+    store.apply(_edits(g, 1))
+    with pytest.raises(WalCorruptError, match="does not chain"):
+        replay(_graph(seed=99), str(tmp_path))
+
+
+def test_replay_skips_compacted_prefix(tmp_path):
+    g = _graph()
+    store = SnapshotStore(g, wal_dir=str(tmp_path))
+    store.apply(_edits(g, 1))
+    mid = store.current()
+    store.apply(_edits(g, 2))
+    head = store.current()
+    # Replay from the v1 graph: the v0->v1 records predate it and must
+    # be skipped until the chain anchors at v1's fingerprint.
+    r = replay(mid.graph, str(tmp_path))
+    assert r.version == 2
+    assert r.fingerprint == head.fingerprint
+    assert r.skipped >= 1
+
+
+def test_compact_drops_committed_prefix(tmp_path):
+    g = _graph()
+    store = SnapshotStore(g, wal_dir=str(tmp_path))
+    store.apply(_edits(g, 1))
+    fp1 = store.current().fingerprint
+    store.apply(_edits(g, 2))
+    w = store._wal
+    dropped = w.compact(fp1)
+    assert dropped == 2              # edits + commit for v1
+    r = replay(store.get(1).graph, str(tmp_path))
+    assert r.version == 2 and r.skipped == 0
+    with pytest.raises(ValueError, match="no commit record"):
+        w.compact("0" * 64)
+
+
+# -- crash-point recovery through the serving session ---------------------
+
+
+def test_crash_during_warm_recovers_bitwise(tmp_path, monkeypatch):
+    from lux_tpu.serve import ServeConfig, Session
+
+    monkeypatch.setenv("LUX_WAL_DIR", str(tmp_path))
+    g = _graph()
+    s = Session(g, ServeConfig(max_batch=2, window_s=0.001), warm=False)
+    s.apply_edits(_edits(g, 5))
+    surviving_fp = s.store.current().fingerprint
+    faults.arm("snapshot.warm:crash:1.0")
+    # The crash fires between the durable mint and the serving flip; it
+    # must escape every `except Exception` on the way out.
+    with pytest.raises(faults.CrashPoint):
+        s.apply_edits(_edits(g, 6))
+    faults.disarm()
+    crashed_head = s.store.current()
+    assert crashed_head.version == 2          # minted before the crash
+    assert s.version == 1                     # never served
+    s.close()
+
+    recovered = SnapshotStore.recover(_graph(), str(tmp_path))
+    assert recovered.current().version == 2
+    assert recovered.current().fingerprint == crashed_head.fingerprint
+    assert recovered.current().fingerprint != surviving_fp
+    # A fresh session serves the recovered store directly.
+    s2 = Session(recovered, ServeConfig(max_batch=2, window_s=0.001),
+                 warm=False)
+    assert s2.version == 2
+    out = s2.query("sssp", start=0, timeout=60)
+    assert out["values"].shape == (g.nv,)
+    s2.close()

@@ -141,6 +141,11 @@ def main() -> int:
     p.add_argument("--swap-at", type=float, default=None, dest="swap_at",
                    help="seconds into the run to apply a ~1%% random "
                    "edit batch and hot-swap serving (in-process mode)")
+    p.add_argument("--faults", default=None,
+                   help="arm a utils/faults.py spec for the measured "
+                   "run (after warmup), e.g. "
+                   "'serve.engine.execute:raise:0.05' — benchmark "
+                   "latency under injected failures (in-process mode)")
     p.add_argument("--json", action="store_true",
                    help="emit one machine-readable serve_bench.v1 JSON "
                    "line at the end")
@@ -182,6 +187,16 @@ def main() -> int:
         print("--swap-at requires in-process mode (not --url)",
               file=sys.stderr)
         return 2
+    if args.faults and session is None:
+        print("--faults requires in-process mode (not --url)",
+              file=sys.stderr)
+        return 2
+    if args.faults:
+        from lux_tpu.utils import faults
+
+        # Armed AFTER warmup so the injected failures land on the
+        # serving path the SLO numbers describe, not on builds.
+        faults.arm(args.faults)
 
     w = max(0.0, min(1.0, args.sssp_weight))
     mix = [("sssp", w), ("pagerank", (1 - w) / 2),
@@ -291,6 +306,14 @@ def main() -> int:
     print(f"  server      shed={report['shed']} "
           f"rejected={report['rejected']} "
           f"recompiles={report['recompiles']}")
+    if args.faults:
+        from lux_tpu.utils import faults
+
+        faults.disarm()
+        report["faults"] = {"spec": args.faults,
+                            "injected": faults.counts()}
+        print(f"  faults      {args.faults} -> "
+              f"injected {report['faults']['injected']}")
     if swap_result:
         report["snapshot"] = swap_result
         if "error" in swap_result:

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -27,7 +27,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke race-stress chaos-stress
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke race-stress chaos-stress
 
 bench:
 	python bench.py
@@ -51,6 +51,12 @@ merge-smoke:
 # barrier, incremental cache refresh, zero recompiles, one swap trace-id.
 snapshot-smoke:
 	python tools/snapshot_smoke.py
+
+# Multi-chip serving acceptance: sharded engines on a virtual 8-way CPU
+# mesh behind the warm pool — bitwise parity vs single-chip, hot-swap of
+# the whole engine mesh under load, zero recompiles, /statusz mesh view.
+serve-sharded-smoke:
+	python tools/serve_sharded_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

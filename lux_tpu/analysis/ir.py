@@ -478,6 +478,9 @@ def build_executor(kind: str, graph, program):
     if kind == "push_sharded":
         from lux_tpu.engine.push import ShardedPushExecutor
         return ShardedPushExecutor(graph, program)
+    if kind == "push_multi_sharded":
+        from lux_tpu.engine.push import ShardedMultiSourcePushExecutor
+        return ShardedMultiSourcePushExecutor(graph, program, k=4)
     raise ValueError(f"unknown executor kind {kind!r}")
 
 

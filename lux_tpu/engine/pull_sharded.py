@@ -286,6 +286,11 @@ class ShardedPullExecutor:
         p = self.num_parts
         return p * (p - 1) * self.sg.max_nv * width * itemsize
 
+    def exchange_bytes_per_iter(self) -> int:
+        """Public form of the per-iteration exchange estimate (the
+        serving layer reports it in serve_bench.v1 mesh evidence)."""
+        return self._exchange_bytes_per_iter()
+
     def run(self, num_iters: int, vals=None, flush_every: int = 8,
             recorder=None):
         if vals is None:

@@ -860,6 +860,27 @@ class MultiSourcePushExecutor:
         return np.asarray(jax.device_get(state.values[:, j]))
 
 
+def _validated_sg(sg: Optional[ShardedGraph], graph: Graph,
+                  num_parts: int) -> ShardedGraph:
+    """Accept a prebuilt partition plan (the serving layer caches one
+    per (fingerprint, parts) — serve/mesh.py) after checking it really
+    describes this executor's graph and mesh; build fresh otherwise."""
+    if sg is None:
+        return ShardedGraph.build(graph, num_parts)
+    if sg.num_parts != num_parts:
+        raise ValueError(
+            f"prebuilt ShardedGraph has {sg.num_parts} parts, mesh has "
+            f"{num_parts}"
+        )
+    if sg.graph is not graph:
+        raise ValueError(
+            "prebuilt ShardedGraph was built from a different Graph "
+            "object — edge indices and partition bounds would not "
+            "match this executor's graph"
+        )
+    return sg
+
+
 class ShardedPushExecutor:
     """Push executor over an N-device mesh with the same two per-iteration
     strategies as the single-device engine, chosen on-device each
@@ -894,6 +915,7 @@ class ShardedPushExecutor:
         queue_frac: int = 16,       # per-shard queue = max_nv/queue_frac + slack
         edge_budget_frac: int = 8,  # per-shard edge budget = max_ne/frac
         blocked_dense: Optional[bool] = None,
+        sg: Optional[ShardedGraph] = None,
     ):
         if program.needs_weights and graph.weights is None:
             raise ValueError(f"{program.name} requires an edge-weighted graph")
@@ -901,7 +923,7 @@ class ShardedPushExecutor:
         self.num_parts = self.mesh.devices.size
         self.graph = graph
         self.program = program
-        self.sg = ShardedGraph.build(graph, self.num_parts)
+        self.sg = _validated_sg(sg, graph, self.num_parts)
         sh = parts_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), sh)
 
@@ -1408,13 +1430,8 @@ class ShardedPushExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
-            # Dense-branch upper bound: each part broadcasts its candidate
-            # table (max_nv values @4B + 1B flag) to the P-1 others. The
-            # sparse branch moves less; per-branch accounting would need
-            # device readbacks the fixpoint loop doesn't do.
-            p = self.num_parts
             rec.set_exchange_bytes(
-                p * (p - 1) * self.sg.max_nv * 5, note="dense_estimate")
+                self.exchange_bytes_per_iter(), note="dense_estimate")
         state, total, self.sparse_iters = _run_to_fixpoint(
             self._multi, state, max_iters, chunk, recorder=rec
         )
@@ -1438,5 +1455,248 @@ class ShardedPushExecutor:
             "sharded": True,
         }
 
+    def exchange_bytes_per_iter(self) -> int:
+        """Dense-branch upper bound on cross-device traffic: each part
+        broadcasts its candidate table (max_nv values @4B + 1B flag) to
+        the P-1 others. The sparse branch moves less; per-branch
+        accounting would need device readbacks the fixpoint loop doesn't
+        do. This is the number PERF.md's serve_bench.v1 evidence policy
+        reports per device."""
+        p = self.num_parts
+        return p * (p - 1) * self.sg.max_nv * 5
+
     def gather_values(self, state: PushState) -> np.ndarray:
         return self.sg.from_padded(np.asarray(jax.device_get(state.values)))
+
+
+class ShardedMultiSourcePushExecutor:
+    """Multi-source push over an N-device mesh: K value lanes per vertex,
+    dense pull-direction sweeps, one shared halt count — the sharded
+    serving form of :class:`MultiSourcePushExecutor`, so K batched SSSP
+    roots cost one distributed sweep instead of K.
+
+    Layout composes the two parents directly: state arrays are
+    ``(P, max_nv, K)`` (the partition plan's padded shards, lane axis
+    trailing); each iteration all-gathers the (values, frontier) shards
+    into a ``(P*max_nv, K)`` global table — the same whole-region
+    exchange as :class:`ShardedPushExecutor`'s dense branch, K lanes
+    wide — then relaxes over the local CSC shard with the per-lane
+    identity mask and segment-reduces into local destinations. Per-lane
+    fixpoints are monotone, so the shared halt count only repeats no-op
+    iterations on early-finishing lanes (the single-device argument,
+    unchanged by sharding).
+
+    Dense-only, like the single-device multi-source engine: queue
+    compaction and bit-packing are single-lane-shaped. The serving layer
+    routes batch-of-one queries to the adaptive ``ShardedPushExecutor``
+    and lands K-lane batches here.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        program: PushProgram,
+        k: int,
+        mesh: Optional[Mesh] = None,
+        num_parts: Optional[int] = None,
+        sg: Optional[ShardedGraph] = None,
+    ):
+        if k < 1:
+            raise ValueError(f"batch width k must be >= 1 (got {k})")
+        if program.needs_weights and graph.weights is None:
+            raise ValueError(f"{program.name} requires an edge-weighted graph")
+        self.mesh = mesh if mesh is not None else make_mesh(num_parts)
+        self.num_parts = self.mesh.devices.size
+        self.graph = graph
+        self.program = program
+        self.k = int(k)
+        self.sg = _validated_sg(sg, graph, self.num_parts)
+        sh = parts_sharding(self.mesh)
+        put = lambda x: jax.device_put(jnp.asarray(x), sh)
+        dg = {
+            "src_pidx": put(self.sg.src_pidx),
+            "dst_local": put(self.sg.dst_local),
+            "vertex_mask": put(self.sg.vertex_mask),
+        }
+        if self.sg.weights is not None:
+            dg["weights"] = put(self.sg.weights)
+        self._dg = dg
+        self._specs = {key: P(PARTS_AXIS) for key in dg}
+        self.sparse_iters = 0   # API parity with the sharded push engine
+        state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
+        mapped = compat.shard_map(
+            self._shard_step,
+            mesh=self.mesh,
+            in_specs=(state_spec, self._specs),
+            out_specs=(state_spec, P(PARTS_AXIS)),
+        )
+        self._step = jax.jit(mapped, donate_argnums=0)
+        self._chunk_cache = {}
+
+    def _iter_block(self, state: PushState, dg):
+        """One dense K-lane iteration on this shard's (1, max_nv, K)
+        blocks; returns the new blocks and the local new-frontier count
+        (summed over lanes)."""
+        prog = self.program
+        v = state.values[0]                            # (max_nv, K)
+        f = state.frontier[0]
+        all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1, self.k)
+        all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1, self.k)
+        sidx = dg["src_pidx"][0]
+        src_vals = all_v[sidx]                         # (max_ne, K)
+        src_front = all_f[sidx]
+        w = dg["weights"][0] if "weights" in dg else None
+        cand = prog.relax(src_vals, None if w is None else w[:, None])
+        ident = identity_for(prog.combiner, cand.dtype)
+        cand = jnp.where(src_front, cand, ident)
+        # Pad edges carry dst_local == max_nv: they land in the dropped
+        # trash segment for every lane, so no edge mask is needed here
+        # (same trick as the sharded single-source dense branch).
+        acc = segment_reduce(
+            cand, dg["dst_local"][0], num_segments=self.sg.max_nv + 1,
+            kind=prog.combiner,
+        )[: self.sg.max_nv]
+        if prog.combiner == "min":
+            new = jnp.minimum(v, acc)
+        else:
+            new = jnp.maximum(v, acc)
+        vmask = dg["vertex_mask"][0][:, None]
+        new = jnp.where(vmask, new, v)
+        frontier = (new != v) & vmask
+        return (
+            PushState(new[None], frontier[None]),
+            frontier.sum(dtype=jnp.int32),
+        )
+
+    def _shard_step(self, state: PushState, dg):
+        new_state, cnt = self._iter_block(state, dg)
+        return new_state, cnt[None]
+
+    def _shard_chunk(self, state: PushState, dg, limit, k: int):
+        def one_iter(st):
+            new_state, cnt_local = self._iter_block(st, dg)
+            return (
+                new_state,
+                jax.lax.psum(cnt_local, PARTS_AXIS),
+                jnp.int32(0),
+            )
+
+        st, counts, flags, done, last = _chunk_while(
+            one_iter, state, k, limit[0]
+        )
+        return st, counts[None], flags[None], done[None], last[None]
+
+    def _multi(self, state: PushState, limit: int, k: int):
+        if k not in self._chunk_cache:
+            state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
+            mapped = compat.shard_map(
+                lambda st, dg, lim: self._shard_chunk(st, dg, lim, k),
+                mesh=self.mesh,
+                in_specs=(state_spec, self._specs, P()),
+                out_specs=(
+                    state_spec,
+                    P(PARTS_AXIS),
+                    P(PARTS_AXIS),
+                    P(PARTS_AXIS),
+                    P(PARTS_AXIS),
+                ),
+            )
+            self._chunk_cache[k] = jax.jit(mapped, donate_argnums=0)
+        return self._chunk_cache[k](
+            state, self._dg, jnp.full((1,), limit, jnp.int32)
+        )
+
+    def init_state(self, starts) -> PushState:
+        """(P, max_nv, K) state with one lane per root; short batches are
+        right-padded by repeating the last root (duplicate lanes converge
+        identically — results, iteration counts, and the executable shape
+        are all unchanged: the zero-recompile contract)."""
+        starts = list(starts)
+        if not 1 <= len(starts) <= self.k:
+            raise ValueError(
+                f"need 1..{self.k} roots, got {len(starts)}"
+            )
+        starts = starts + [starts[-1]] * (self.k - len(starts))
+        prog = self.program
+        vals = np.stack(
+            [prog.init_values(self.graph, start=s) for s in starts], axis=1
+        )
+        fr = np.stack(
+            [prog.init_frontier(self.graph, start=s) for s in starts], axis=1
+        )
+        sh = parts_sharding(self.mesh)
+        return PushState(
+            jax.device_put(jnp.asarray(self.sg.to_padded(vals)), sh),
+            jax.device_put(jnp.asarray(self.sg.to_padded(fr)), sh),
+        )
+
+    def step(self, state: PushState):
+        return self._step(state, self._dg)
+
+    def run(
+        self,
+        starts,
+        max_iters: Optional[int] = None,
+        chunk: int = 16,
+        recorder=None,
+        state: Optional[PushState] = None,
+    ):
+        """Run all roots in ``starts`` to their shared fixpoint; returns
+        (final_state, iterations_run). ``gather_values(state)[:, j]`` is
+        root ``starts[j]``'s result — bit-identical to a single-source
+        run from that root (integer min/max combiners commute with the
+        partitioned reduction order)."""
+        if state is None:
+            state = self.init_state(starts)
+        rec = recorder if recorder is not None else recorder_for(
+            "push_multi_sharded", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            rec.set_exchange_bytes(
+                self.exchange_bytes_per_iter(), note="dense_estimate")
+        state, total, _ = _run_to_fixpoint(
+            self._multi, state, max_iters, chunk, recorder=rec
+        )
+        rec.finish()
+        return state, total
+
+    def warmup(self, chunk: int = 16, start: int = 0):
+        """Compile the chunked executable outside any timed/served
+        request (the serving pool calls this once per keyed engine)."""
+        with Timer() as t:
+            _run_to_fixpoint(
+                self._multi, self.init_state([start]), 1, chunk
+            )
+        note_compile_seconds(self, t.elapsed)
+
+    def trace_step(self, start: int = 0, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
+        sharded=True, so LUX105 demands a collective in the trace."""
+        return {
+            "kind": "push_multi_sharded",
+            "fn": self._step,
+            "args": (self.init_state([start]), self._dg),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": True,
+        }
+
+    def exchange_bytes_per_iter(self) -> int:
+        """Per-iteration exchange upper bound: the K-lane candidate
+        table broadcast — (max_nv values @4B + 1B flag) x K lanes from
+        each part to the P-1 others."""
+        p = self.num_parts
+        return p * (p - 1) * self.sg.max_nv * self.k * 5
+
+    def gather_values(self, state: PushState) -> np.ndarray:
+        """(nv, K) host array: every lane's values in one device fetch
+        (the serving layer slices columns out of this rather than paying
+        one device round-trip per lane)."""
+        return self.sg.from_padded(np.asarray(jax.device_get(state.values)))
+
+    def values_for(self, state: PushState, j: int) -> np.ndarray:
+        """Host copy of lane ``j``'s value column."""
+        return self.sg.from_padded(
+            np.asarray(jax.device_get(state.values[:, :, j]))
+        )

@@ -26,7 +26,8 @@ ROOTED_APPS = frozenset({"sssp"})
 # push needs a PushProgram; multi-source batching needs a rooted app.
 ENGINE_KINDS = {
     "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded"),
-    "sssp": ("push", "push_multi", "push_incremental", "push_sharded"),
+    "sssp": ("push", "push_multi", "push_incremental", "push_sharded",
+             "push_multi_sharded"),
     "components": ("push", "push_incremental", "push_sharded"),
     "colfilter": ("pull", "pull_sharded"),
 }

@@ -7,8 +7,12 @@ through a bounded admission queue (:class:`MicroBatcher`), and fronts an
 LRU :class:`ResultCache`. K concurrent SSSP root queries inside one
 batching window run as ONE dense multi-source sweep
 (engine/push.py ``MultiSourcePushExecutor``); root-free fixpoints
-(PageRank, CC) are served from the cache. ``serve/http.py`` is the
-stdlib JSON front end: ``python -m lux_tpu.serve.http -file g.lux``.
+(PageRank, CC) are served from the cache. With ``LUX_SERVE_MESH`` (or
+``ServeConfig(mesh=...)``) every engine is *sharded* over a device mesh
+(``serve/mesh.py``; virtual XLA host devices on CPU) — pool keys embed
+the mesh shape so warm multi-chip engines serve with zero recompiles.
+``serve/http.py`` is the stdlib JSON front end:
+``python -m lux_tpu.serve.http -file g.lux``.
 """
 
 from lux_tpu.serve.batcher import MicroBatcher, Request
@@ -22,12 +26,16 @@ from lux_tpu.serve.errors import (
     ServeError,
     SnapshotSwapError,
 )
+from lux_tpu.serve.mesh import MeshSpec, ShardPlanCache, serving_mesh
 from lux_tpu.serve.pool import EnginePool
 from lux_tpu.serve.session import ServeConfig, Session
 
 __all__ = [
     "Session",
     "ServeConfig",
+    "MeshSpec",
+    "ShardPlanCache",
+    "serving_mesh",
     "EnginePool",
     "ResultCache",
     "MicroBatcher",

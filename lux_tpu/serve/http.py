@@ -311,6 +311,9 @@ def main(argv: Optional[list] = None) -> int:
                    dest="deadline_s", help="default per-request deadline")
     p.add_argument("-pagerank-iters", type=int, default=20,
                    dest="pagerank_iters")
+    p.add_argument("-mesh", default=None,
+                   help="serving mesh spec ('8' or 'PxQ'); default "
+                   "LUX_SERVE_MESH. Virtual XLA host devices on CPU")
     args = p.parse_args(argv)
 
     log = get_logger("serve")
@@ -320,6 +323,7 @@ def main(argv: Optional[list] = None) -> int:
         max_queue=args.max_queue,
         default_deadline_s=args.deadline_s,
         pagerank_iters=args.pagerank_iters,
+        mesh=args.mesh,
     )
     session = Session(args.file, cfg)
     server = make_server(session, args.host, args.port)
@@ -328,10 +332,11 @@ def main(argv: Optional[list] = None) -> int:
                  flags.get("LUX_FLIGHT_DIR"))
     log.info(
         "serving %s (nv=%d ne=%d) on http://%s:%d  "
-        "[max_batch=%d window=%.1fms queue=%d]",
+        "[max_batch=%d window=%.1fms queue=%d mesh=%s]",
         args.file, session.graph.nv, session.graph.ne,
         args.host, server.server_address[1],
         cfg.max_batch, cfg.window_s * 1e3, cfg.max_queue,
+        session.meshspec.spec,
     )
     try:
         server.serve_forever()

@@ -107,6 +107,12 @@ class EnginePool:
         with self._lock:
             return len(self._engines)
 
+    def keys(self) -> list:
+        """Snapshot of the live engine keys (observability: /statusz
+        groups pool entries by the mesh-shape key component)."""
+        with self._lock:
+            return list(self._engines)
+
     def stats(self) -> dict:
         return {
             "engines": len(self),

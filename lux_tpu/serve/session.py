@@ -48,6 +48,7 @@ from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
 from lux_tpu.serve.errors import (BadQueryError, QueueFullError,
                                   ServeError, SnapshotSwapError)
+from lux_tpu.serve.mesh import plan_cache, serving_mesh
 from lux_tpu.serve.pool import EnginePool
 from lux_tpu.utils import faults, flags
 from lux_tpu.utils.locks import make_lock
@@ -66,6 +67,7 @@ class ServeConfig:
         cache_capacity: int = 256,   # LRU entries
         default_deadline_s: Optional[float] = None,
         pagerank_iters: int = 20,    # served fixpoint depth
+        mesh: Optional[str] = None,  # serving mesh spec; None = LUX_SERVE_MESH
     ):
         self.max_batch = int(max_batch)
         self.window_s = float(window_s)
@@ -73,6 +75,16 @@ class ServeConfig:
         self.cache_capacity = int(cache_capacity)
         self.default_deadline_s = default_deadline_s
         self.pagerank_iters = int(pagerank_iters)
+        self.mesh = mesh
+
+
+def _host_values(ex, state) -> np.ndarray:
+    """Host-side per-vertex values from an executor state: sharded
+    executors unpad their stacked shards (``gather_values``); flat ones
+    hand back ``state.values`` directly."""
+    if hasattr(ex, "gather_values"):
+        return np.asarray(ex.gather_values(state))
+    return np.asarray(state.values)
 
 
 class Session:
@@ -92,6 +104,11 @@ class Session:
     ):
         self.log = get_logger("serve")
         self.config = config or ServeConfig()
+        # Resolve the serving mesh up front: engine pool keys embed its
+        # shape, so one session serves one mesh for its whole lifetime
+        # (multi-chip serving, ISSUE 10 — P > 1 routes every engine
+        # build through the sharded executors + the shard-plan cache).
+        self.meshspec = serving_mesh(self.config.mesh)
         self.graph_path: Optional[str] = None
         if isinstance(graph, SnapshotStore):
             # Crash recovery: serve a store rebuilt by
@@ -153,34 +170,76 @@ class Session:
     # -- engines ---------------------------------------------------------
 
     def _engine_key(self, kind: str, snap: Snapshot, extra=()) -> tuple:
-        return (kind, snap.fingerprint) + tuple(extra)
+        # The trailing mesh-shape component makes the key the full
+        # (program, fingerprint, batch width, mesh shape) tuple: a warm
+        # sharded engine can never answer for a single-chip one (or for
+        # a different mesh), and /statusz groups pool entries by it.
+        return ((kind, snap.fingerprint) + tuple(extra)
+                + (self.meshspec.shape,))
+
+    @property
+    def sharded(self) -> bool:
+        return self.meshspec.num_parts > 1
+
+    def _shard_plan(self, snap: Snapshot):
+        """The snapshot's partition plan from the process-wide cache —
+        every sharded engine for (fingerprint, parts) shares one O(ne)
+        host build, and the hot-swap drain evicts it with the engines."""
+        return plan_cache().get(
+            snap.fingerprint, snap.graph, self.meshspec.num_parts
+        )
 
     def _sssp_single(self, snap: Optional[Snapshot] = None):
-        from lux_tpu.engine.push import PushExecutor
+        from lux_tpu.engine.push import PushExecutor, ShardedPushExecutor
         from lux_tpu.models.sssp import SSSP
 
         snap = snap or self._serving
+        if self.sharded:
+            return self.pool.get(
+                self._engine_key("push", snap, ("sssp", 1)),
+                lambda: ShardedPushExecutor(
+                    snap.graph, SSSP(), mesh=self.meshspec.mesh,
+                    sg=self._shard_plan(snap),
+                ),
+            )
         return self.pool.get(
             self._engine_key("push", snap, ("sssp", 1)),
             lambda: PushExecutor(snap.graph, SSSP()),
         )
 
     def _sssp_multi(self, snap: Optional[Snapshot] = None):
-        from lux_tpu.engine.push import MultiSourcePushExecutor
+        from lux_tpu.engine.push import (MultiSourcePushExecutor,
+                                         ShardedMultiSourcePushExecutor)
         from lux_tpu.models.sssp import SSSP
 
         snap = snap or self._serving
         k = self.config.max_batch
+        if self.sharded:
+            return self.pool.get(
+                self._engine_key("push_multi", snap, ("sssp", k)),
+                lambda: ShardedMultiSourcePushExecutor(
+                    snap.graph, SSSP(), k=k, mesh=self.meshspec.mesh,
+                    sg=self._shard_plan(snap),
+                ),
+            )
         return self.pool.get(
             self._engine_key("push_multi", snap, ("sssp", k)),
             lambda: MultiSourcePushExecutor(snap.graph, SSSP(), k=k),
         )
 
     def _components_engine(self, snap: Optional[Snapshot] = None):
-        from lux_tpu.engine.push import PushExecutor
+        from lux_tpu.engine.push import PushExecutor, ShardedPushExecutor
         from lux_tpu.models.components import ConnectedComponents
 
         snap = snap or self._serving
+        if self.sharded:
+            return self.pool.get(
+                self._engine_key("push", snap, ("components", 1)),
+                lambda: ShardedPushExecutor(
+                    snap.graph, ConnectedComponents(),
+                    mesh=self.meshspec.mesh, sg=self._shard_plan(snap),
+                ),
+            )
         return self.pool.get(
             self._engine_key("push", snap, ("components", 1)),
             lambda: PushExecutor(snap.graph, ConnectedComponents()),
@@ -199,16 +258,25 @@ class Session:
                 # The tiled fast path persists its hybrid plan next to
                 # the graph file; an in-memory graph has none, and an
                 # edited snapshot no longer matches the on-disk plan —
-                # both serve from the flat pull engine.
+                # both serve from the (sharded, when P > 1) pull engine.
+                if self.sharded:
+                    from lux_tpu.engine.pull_sharded import \
+                        ShardedPullExecutor
+
+                    return ShardedPullExecutor(
+                        snap.graph, PageRank(), mesh=self.meshspec.mesh,
+                        sg=self._shard_plan(snap),
+                    )
                 return PullExecutor(snap.graph, PageRank())
             import argparse
 
             # Reuse the CLI's engine-selection policy (tiled when
-            # SpMV-shaped) with serving defaults.
+            # SpMV-shaped; -parts folds the serving mesh) with serving
+            # defaults.
             args = argparse.Namespace(
-                parts=1, layout="auto", strategy="rowptr",
-                levels="8/2", tile_mb=8192, plan_cache=None,
-                file=self.graph_path,
+                parts=self.meshspec.num_parts, layout="auto",
+                strategy="rowptr", levels="8/2", tile_mb=8192,
+                plan_cache=None, file=self.graph_path,
             )
             return make_executor(snap.graph, PageRank(), args, self.log)
 
@@ -463,7 +531,7 @@ class Session:
                 with spans.span("serve.engine", app="sssp", engine="push",
                                 lanes=1):
                     state, iters = ex.run(start=roots[0])
-                    return [np.asarray(state.values)], int(iters)
+                    return [_host_values(ex, state)], int(iters)
         else:
             key = self._engine_key(
                 "push_multi", snap, ("sssp", self.config.max_batch)
@@ -474,6 +542,15 @@ class Session:
                 with spans.span("serve.engine", app="sssp",
                                 engine="push_multi", lanes=len(roots)):
                     state, iters = ex.run(roots)
+                    if hasattr(ex, "gather_values"):
+                        # Sharded lanes: one device→host gather + unpad
+                        # for the whole batch, then column slices — not
+                        # len(roots) separate transfers.
+                        allv = ex.gather_values(state)
+                        return [
+                            np.ascontiguousarray(allv[:, j])
+                            for j in range(len(roots))
+                        ], int(iters)
                     return [
                         ex.values_for(state, j) for j in range(len(roots))
                     ], int(iters)
@@ -493,7 +570,7 @@ class Session:
             with spans.span("serve.engine", app="components",
                             engine="push"):
                 state, iters = ex.run()
-                return {"values": np.asarray(state.values),
+                return {"values": _host_values(ex, state),
                         "iters": int(iters)}
 
         return self._engine_execute("components", snap, key, deadline,
@@ -716,7 +793,13 @@ class Session:
             )
 
         refreshed = None
-        if flags.get_bool("LUX_INCREMENTAL") and refresh_edits is not None:
+        # Sharded serving degrades to evict-only: the incremental
+        # executor warm-starts flat single-device states, which don't
+        # compose with the padded per-shard layout. Eviction is always
+        # correct — the warmed mesh of N+1 engines is already in the
+        # pool by this point, so the flip still costs zero recompiles.
+        if (flags.get_bool("LUX_INCREMENTAL") and refresh_edits is not None
+                and self.meshspec.num_parts == 1):
             try:
                 refreshed = self._incremental_refresh(old, snap,
                                                       refresh_edits)
@@ -790,7 +873,12 @@ class Session:
                      and k[1] == old_fp}
             # luxlint: disable=LUX301 -- barrier runs on the batcher thread
             self._served_keys -= stale
-            return {"evicted": evicted, "retired": retired}
+            # The outgoing snapshot's partition plans go with its
+            # engines — a sharded swap atomically replaces the whole
+            # mesh of engines plus the host-side plan they shared.
+            plans = plan_cache().evict_fingerprint(old_fp)
+            return {"evicted": evicted, "retired": retired,
+                    "plans_evicted": plans}
 
         while True:
             try:
@@ -902,6 +990,44 @@ class Session:
 
     # -- introspection / lifecycle ---------------------------------------
 
+    def _mesh_block(self) -> dict:
+        """The serving-mesh view shared by ``stats`` and ``/statusz``:
+        mesh spec/shape plus live pool entries grouped by the mesh-shape
+        component of their key (a hot-swap mid-drain shows both the
+        incoming and outgoing mesh populations here)."""
+        by_shape: Dict[str, int] = {}
+        for k in self.pool.keys():
+            shape = (k[-1] if isinstance(k, tuple) and k
+                     and isinstance(k[-1], tuple) else None)
+            label = "x".join(map(str, shape)) if shape else "?"
+            by_shape[label] = by_shape.get(label, 0) + 1
+        return {
+            "spec": self.meshspec.spec,
+            "shape": list(self.meshspec.shape),
+            "num_parts": self.meshspec.num_parts,
+            "pool_entries": by_shape,
+            "plans": plan_cache().stats(),
+        }
+
+    def mesh_exchange_bytes(self) -> dict:
+        """Per-app dense-estimate exchange bytes per iteration for the
+        warm sharded engines ({} on a single-chip mesh). serve_bench
+        publishes this in the serve_bench.v1 mesh evidence block."""
+        if not self.sharded:
+            return {}
+        out = {}
+        for app, get_engine in (
+            ("sssp", self._sssp_single),
+            ("sssp_multi", self._sssp_multi),
+            ("components", self._components_engine),
+            ("pagerank", self._pagerank_engine),
+        ):
+            ex = get_engine()
+            fn = getattr(ex, "exchange_bytes_per_iter", None)
+            if fn is not None:
+                out[app] = int(fn())
+        return out
+
     def stats(self) -> dict:
         snap = self._serving
         s = {
@@ -913,6 +1039,7 @@ class Session:
             "pool": self.pool.stats(),
             "cache": self.cache.stats(),
             "batcher": self.batcher.stats(),
+            "mesh": self._mesh_block(),
             "requests": int(self._requests.value),
         }
         if self._latency.count:
@@ -945,6 +1072,7 @@ class Session:
                       "capacity": b["queue_capacity"]},
             "cache_hit_rate": (c["hits"] / probes) if probes else None,
             "batch_size": self.batcher.batch_histogram(),
+            "mesh": self._mesh_block(),
             "counters": {
                 "requests": int(self._requests.value),
                 "rejected": b["rejected"],

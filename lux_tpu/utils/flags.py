@@ -276,6 +276,17 @@ define("LUX_BREAKER_COOLDOWN_MS", 2000.0,
        "ms an open breaker waits before going half-open and probing the "
        "rebuilt engine in the background", kind="float")
 
+# Multi-chip serving (serve/mesh.py, serve/session.py)
+define("LUX_SERVE_MESH", 1,
+       "serving device mesh spec: a device count ('8') or PxQ shape "
+       "('2x4', folded onto the 1-D parts axis); 1 = single-chip "
+       "serving. On CPU the mesh is virtual (XLA host devices), exactly "
+       "as the RMAT27 tooling runs", kind="str")
+define("LUX_SHARD_PLAN_CACHE", 8,
+       "max (fingerprint, parts) partition plans the serving shard-plan "
+       "cache keeps; hot-swaps evict the outgoing fingerprint's plans "
+       "regardless", kind="int")
+
 # Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
 define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")
 define("LUX_SMOKE_ITERS", 8, "obs_smoke PageRank iterations", kind="int")

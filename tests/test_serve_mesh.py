@@ -1,0 +1,341 @@
+"""Multi-chip serving: mesh-keyed warm pool, sharded engines behind
+Session, partition-plan cache, and hot-swap of a whole engine mesh.
+
+The conftest forces 8 virtual CPU devices, so a 2x4 (or 8-way) serving
+mesh is real sharded execution — the same collectives as TPU, minus the
+wires.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lux_tpu.graph import DeltaGraph, EdgeEdits, generate
+from lux_tpu.models.sssp import SSSP, reference_sssp
+from lux_tpu.obs import metrics
+from lux_tpu.serve import ServeConfig, Session
+from lux_tpu.serve.mesh import (MeshSpec, ShardPlanCache, parse_mesh_spec,
+                                serving_mesh)
+from lux_tpu.serve.pool import EnginePool
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("window_s", 0.01)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("pagerank_iters", 4)
+    return ServeConfig(**kw)
+
+
+def _edits(g, seed, n):
+    rng = np.random.default_rng(seed)
+    ins = [(int(rng.integers(g.nv)), int(rng.integers(g.nv)))
+           for _ in range(n)]
+    eidx = rng.choice(g.ne, size=n, replace=False)
+    dels = [(int(g.col_src[e]), int(g.col_dst[e])) for e in eidx]
+    return EdgeEdits.from_lists(insert=ins, delete=dels)
+
+
+# -- mesh spec parsing / resolution -------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("8") == (8,)
+    assert parse_mesh_spec("2x4") == (2, 4)
+    assert parse_mesh_spec("1") == (1,)
+    assert parse_mesh_spec(" 4 x 2 ") == (4, 2)
+    assert parse_mesh_spec(4) == (4,)
+
+
+@pytest.mark.parametrize("bad", ["", "0", "2x0", "-4", "axb", "2x", None])
+def test_parse_mesh_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_mesh_spec(bad)
+
+
+def test_serving_mesh_resolves_flag(monkeypatch):
+    monkeypatch.setenv("LUX_SERVE_MESH", "2x4")
+    ms = serving_mesh()
+    assert isinstance(ms, MeshSpec)
+    assert ms.shape == (2, 4) and ms.num_parts == 8
+    assert ms.mesh is not None
+
+
+def test_serving_mesh_single_chip_has_no_mesh():
+    ms = serving_mesh("1")
+    assert ms.num_parts == 1 and ms.mesh is None
+
+
+def test_serving_mesh_rejects_oversubscription(monkeypatch):
+    # conftest pins 8 virtual devices; 64 parts cannot be satisfied.
+    # (The bootstrap widens XLA_FLAGS before it can check — restore it.)
+    import os
+
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    with pytest.raises(ValueError, match="devices"):
+        serving_mesh("64")
+
+
+# -- partition-plan cache ------------------------------------------------
+
+
+def test_plan_cache_shares_one_build_per_fingerprint():
+    metrics.reset()   # counters are registry-shared; fresh cache, fresh counts
+    g = generate.gnp(200, 1200, seed=7)
+    pc = ShardPlanCache()
+    a = pc.get("fp0", g, 4)
+    b = pc.get("fp0", g, 4)
+    assert a is b and len(pc) == 1
+    # A different parts count is a different plan.
+    c = pc.get("fp0", g, 2)
+    assert c is not a and len(pc) == 2
+    st = pc.stats()
+    assert st["hits"] == 1 and st["misses"] == 2
+
+
+def test_plan_cache_rebuilds_on_graph_identity_change():
+    g1 = generate.gnp(200, 1200, seed=7)
+    g2 = generate.gnp(200, 1200, seed=7)   # equal content, new object
+    pc = ShardPlanCache()
+    a = pc.get("fp0", g1, 4)
+    b = pc.get("fp0", g2, 4)   # same key, different Graph object
+    assert b is not a and b.graph is g2
+
+
+def test_plan_cache_evict_fingerprint():
+    g = generate.gnp(150, 800, seed=8)
+    pc = ShardPlanCache()
+    pc.get("old", g, 2)
+    pc.get("old", g, 4)
+    pc.get("new", g, 2)
+    assert pc.evict_fingerprint("old") == 2
+    assert len(pc) == 1
+    assert pc.evict_fingerprint("gone") == 0
+
+
+def test_plan_cache_lru_bound(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("LUX_SHARD_PLAN_CACHE", "2")
+    g = generate.gnp(150, 800, seed=8)
+    pc = ShardPlanCache()
+    pc.get("a", g, 2)
+    pc.get("b", g, 2)
+    pc.get("c", g, 2)
+    assert len(pc) == 2
+    assert pc.stats()["evicted"] == 1
+
+
+# -- sharded serving through Session ------------------------------------
+
+
+def test_sharded_session_parity_and_mesh_keys():
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=411, weighted=True)
+    with Session(g, _cfg(mesh="1"), warm=False) as s1, \
+            Session(g, _cfg(mesh="2x4"), warm=False) as s8:
+        assert s8.meshspec.num_parts == 8
+        # SSSP + components bitwise; pagerank float-order tolerant.
+        for r in (0, 7, 133):
+            a = s1.query("sssp", start=r, timeout=120)
+            b = s8.query("sssp", start=r, timeout=120)
+            np.testing.assert_array_equal(a["values"], b["values"])
+            np.testing.assert_array_equal(b["values"],
+                                          reference_sssp(g, r))
+        np.testing.assert_array_equal(
+            s1.query("components", timeout=120)["values"],
+            s8.query("components", timeout=120)["values"])
+        np.testing.assert_allclose(
+            s1.query("pagerank", timeout=120)["values"],
+            s8.query("pagerank", timeout=120)["values"],
+            rtol=1e-5, atol=1e-8)
+        # Every pool key carries its session's mesh shape.
+        assert all(k[-1] == (2, 4) for k in s8.pool.keys())
+        assert all(k[-1] == (1,) for k in s1.pool.keys())
+        assert s8.stats()["pool"]["recompiles"] == 0
+        assert s1.stats()["pool"]["recompiles"] == 0
+
+
+def test_sharded_batched_lanes_parity():
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=412)
+    roots = [2, 9, 55, 201]
+    with Session(g, _cfg(mesh="8"), warm=False) as s:
+        futs = [s.submit("sssp", start=r) for r in roots]
+        for r, f in zip(roots, futs):
+            np.testing.assert_array_equal(
+                f.result(timeout=120)["values"], reference_sssp(g, r))
+        # The batched lanes came off the sharded multi engine.
+        assert any(k[0] == "push_multi" for k in s.pool.keys())
+        assert s.stats()["pool"]["recompiles"] == 0
+
+
+def test_sharded_warm_path_zero_recompiles():
+    metrics.reset()
+    g = generate.gnp(250, 1500, seed=413)
+    with Session(g, _cfg(mesh="8"), warm=False) as s:
+        for _ in range(3):
+            s.query("sssp", start=1, timeout=120)
+            s.query("components", timeout=120)
+        st = s.stats()["pool"]
+        assert st["recompiles"] == 0
+        assert st["warmup_compiles"] > 0
+        s.pool.sentinel.assert_zero_recompiles()
+
+
+def test_sharded_hot_swap_retires_engine_mesh_under_load():
+    metrics.reset()
+    g = generate.gnp(300, 2000, seed=414)
+    ed = _edits(g, 415, 12)
+    new_g = DeltaGraph.fresh(g).stack(ed).merged()
+    with Session(g, _cfg(mesh="2x4"), warm=False) as s:
+        s.query("sssp", start=3, timeout=120)
+        s.query("components", timeout=120)
+        warmed = len(s.pool)
+        errors, results = [], []
+
+        def hammer():
+            try:
+                for r in (1, 4, 7):
+                    results.append(
+                        (r, s.query("sssp", start=r, timeout=120)))
+            except Exception as e:   # any failure fails the test
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        summary = s.apply_edits(ed)
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert summary["retired"] >= warmed
+        assert summary["plans_evicted"] >= 1
+        # Post-swap answers come from v1's sharded engines, bitwise.
+        out = s.query("sssp", start=3, timeout=120)
+        np.testing.assert_array_equal(out["values"],
+                                      reference_sssp(new_g, 3))
+        # In-flight answers were correct for whichever version ran them.
+        for r, res in results:
+            v = np.asarray(res["values"])
+            ok = (np.array_equal(v, reference_sssp(g, r))
+                  or np.array_equal(v, reference_sssp(new_g, r)))
+            assert ok, f"root {r} matches neither version"
+        assert s.stats()["pool"]["recompiles"] == 0
+
+
+def test_pool_builds_once_under_concurrent_get_with_mesh_keys():
+    metrics.reset()
+    g = generate.gnp(200, 1200, seed=416)
+    pool = EnginePool("test-mesh")
+    built = []
+
+    def factory():
+        from lux_tpu.engine.push import PushExecutor
+
+        built.append(1)
+        return PushExecutor(g, SSSP())
+
+    key = ("push", "fp", "sssp", 1, (2, 4))
+    got = []
+    threads = [
+        threading.Thread(target=lambda: got.append(pool.get(key, factory)))
+        for _ in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert all(e is got[0] for e in got)
+    assert pool.stats()["misses"] == 1 and pool.stats()["hits"] == 5
+    pool.close()
+
+
+def test_stats_and_statusz_report_mesh():
+    metrics.reset()
+    g = generate.gnp(200, 1200, seed=417)
+    with Session(g, _cfg(mesh="2x4"), warm=False) as s:
+        s.query("sssp", start=0, timeout=120)
+        for doc in (s.stats(), s.statusz()):
+            m = doc["mesh"]
+            assert m["spec"] == "2x4"
+            assert m["shape"] == [2, 4] and m["num_parts"] == 8
+            assert m["pool_entries"].get("2x4", 0) >= 1
+            assert m["plans"]["plans"] >= 1
+        eb = s.mesh_exchange_bytes()
+        assert set(eb) == {"sssp", "sssp_multi", "components",
+                           "pagerank"}
+        assert all(isinstance(v, int) and v > 0 for v in eb.values())
+
+
+def test_single_chip_session_mesh_block_is_inert():
+    metrics.reset()
+    g = generate.gnp(150, 800, seed=418)
+    with Session(g, _cfg(mesh="1"), warm=False) as s:
+        s.query("sssp", start=0, timeout=120)
+        m = s.stats()["mesh"]
+        assert m["num_parts"] == 1
+        assert s.mesh_exchange_bytes() == {}
+
+
+# -- sharded multi-source executor directly ------------------------------
+
+
+def test_sharded_multi_source_parity_weighted():
+    from lux_tpu.engine.push import (MultiSourcePushExecutor,
+                                     ShardedMultiSourcePushExecutor)
+
+    g = generate.gnp(300, 2200, seed=419, weighted=True)
+    roots = [0, 3, 77, 201]
+    ref = MultiSourcePushExecutor(g, SSSP(), k=4)
+    rstate, riters = ref.run(roots)
+    ex = ShardedMultiSourcePushExecutor(g, SSSP(), k=4, num_parts=8)
+    state, iters = ex.run(roots)
+    assert int(iters) == int(riters)
+    allv = ex.gather_values(state)
+    assert allv.shape == (g.nv, 4)
+    for j, r in enumerate(roots):
+        np.testing.assert_array_equal(allv[:, j], ref.values_for(rstate, j))
+        np.testing.assert_array_equal(ex.values_for(state, j),
+                                      reference_sssp(g, r))
+
+
+def test_sharded_multi_source_pads_short_batches():
+    from lux_tpu.engine.push import ShardedMultiSourcePushExecutor
+
+    g = generate.gnp(250, 1500, seed=420)
+    ex = ShardedMultiSourcePushExecutor(g, SSSP(), k=4, num_parts=4)
+    state, _ = ex.run([7])   # right-pads by repeating the last root
+    np.testing.assert_array_equal(ex.values_for(state, 0),
+                                  reference_sssp(g, 7))
+
+
+def test_sharded_multi_source_rejects_bad_widths():
+    from lux_tpu.engine.push import ShardedMultiSourcePushExecutor
+
+    g = generate.gnp(100, 500, seed=421)
+    with pytest.raises(ValueError):
+        ShardedMultiSourcePushExecutor(g, SSSP(), k=0, num_parts=2)
+    ex = ShardedMultiSourcePushExecutor(g, SSSP(), k=2, num_parts=2)
+    with pytest.raises(ValueError):
+        ex.init_state([])
+    with pytest.raises(ValueError):
+        ex.init_state([1, 2, 3])
+
+
+def test_sharded_executors_accept_prebuilt_plan():
+    from lux_tpu.engine.push import (ShardedMultiSourcePushExecutor,
+                                     ShardedPushExecutor)
+    from lux_tpu.parallel.shard import ShardedGraph
+
+    g = generate.gnp(200, 1200, seed=422)
+    sg = ShardedGraph.build(g, 4)
+    a = ShardedPushExecutor(g, SSSP(), num_parts=4, sg=sg)
+    b = ShardedMultiSourcePushExecutor(g, SSSP(), k=2, num_parts=4, sg=sg)
+    assert a.sg is sg and b.sg is sg
+    with pytest.raises(ValueError):
+        ShardedPushExecutor(g, SSSP(), num_parts=2, sg=sg)
+    g2 = generate.gnp(200, 1200, seed=422)
+    with pytest.raises(ValueError):
+        ShardedPushExecutor(g2, SSSP(), num_parts=4, sg=sg)

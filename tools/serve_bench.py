@@ -16,10 +16,17 @@ applied mid-run via ``session.apply_edits`` — the report gains a
 ``snapshot`` block {version, swap_s, errors_during_swap} so SLO checks
 can assert hot-swaps are latency- and error-neutral under load.
 
+With ``--mesh PxQ`` (in-process mode) the session serves from sharded
+engines on a P*Q-device mesh (virtual XLA host devices on CPU) and the
+report gains a ``mesh`` block {spec, num_parts, plans,
+exchange_bytes_per_iter} — the serving half of the PERF.md multi-chip
+evidence.
+
 Examples:
   python tools/serve_bench.py --scale 12 --workers 16 --duration 10
   python tools/serve_bench.py --url http://127.0.0.1:8399 --workers 32
   python tools/serve_bench.py --swap-at 5 --duration 10 --json
+  python tools/serve_bench.py --mesh 2x4 --swap-at 5 --json
   python tools/serve_bench.py --json-out /tmp/bench.json && \
       python tools/slo_check.py --input /tmp/bench.json --baseline slo.json
 """
@@ -134,6 +141,10 @@ def main() -> int:
     p.add_argument("--duration", type=float, default=10.0, help="seconds")
     p.add_argument("--max-batch", type=int, default=8, dest="max_batch")
     p.add_argument("--window-ms", type=float, default=3.0, dest="window_ms")
+    p.add_argument("--mesh", default=None,
+                   help="serving mesh spec for the in-process session "
+                   "('8' or 'PxQ'); on CPU the mesh is virtual (XLA "
+                   "host devices). Default: LUX_SERVE_MESH")
     p.add_argument("--sssp-weight", type=float, default=0.8,
                    dest="sssp_weight",
                    help="fraction of traffic that is SSSP root queries "
@@ -164,6 +175,17 @@ def main() -> int:
         nv = health["nv"]
     else:
         os.environ.setdefault("LUX_PLATFORM", "cpu")
+        if args.mesh:
+            # Virtual devices must exist before the backend initializes:
+            # widen XLA_FLAGS now, exactly as the RMAT27 tooling does.
+            import math
+
+            from lux_tpu.serve.mesh import parse_mesh_spec
+            from lux_tpu.utils.platform import virtual_cpu_flags
+
+            n = math.prod(parse_mesh_spec(args.mesh))
+            if n > 1:
+                os.environ["XLA_FLAGS"] = virtual_cpu_flags(n)
         import jax
 
         from lux_tpu.utils import flags
@@ -179,6 +201,7 @@ def main() -> int:
         session = Session(graph, ServeConfig(
             max_batch=args.max_batch, window_s=args.window_ms / 1e3,
             max_queue=max(64, 4 * args.workers),
+            mesh=args.mesh,
         ))
         client = LocalClient(session)
         nv = session.graph.nv
@@ -190,6 +213,10 @@ def main() -> int:
     if args.faults and session is None:
         print("--faults requires in-process mode (not --url)",
               file=sys.stderr)
+        return 2
+    if args.mesh and session is None:
+        print("--mesh requires in-process mode (not --url); start the "
+              "server under LUX_SERVE_MESH instead", file=sys.stderr)
         return 2
     if args.faults:
         from lux_tpu.utils import faults
@@ -240,6 +267,7 @@ def main() -> int:
                     swap_s=summary["swap_s"],
                     evicted=summary["evicted"],
                     retired=summary["retired"],
+                    plans_evicted=summary.get("plans_evicted", 0),
                 )
             except Exception as e:
                 swap_result.update(error=repr(e),
@@ -306,6 +334,23 @@ def main() -> int:
     print(f"  server      shed={report['shed']} "
           f"rejected={report['rejected']} "
           f"recompiles={report['recompiles']}")
+    mesh = stats.get("mesh")
+    if mesh:
+        report["mesh"] = {
+            "spec": mesh.get("spec"),
+            "shape": mesh.get("shape"),
+            "num_parts": mesh.get("num_parts"),
+            "plans": mesh.get("plans"),
+        }
+        if session is not None and mesh.get("num_parts", 1) > 1:
+            # Per-device collective volume the warm sharded engines move
+            # each iteration — the serving half of the PERF.md exchange
+            # evidence (the batch half comes from bench_sharded.v1).
+            report["mesh"]["exchange_bytes_per_iter"] = (
+                session.mesh_exchange_bytes())
+        print(f"  mesh        {mesh.get('spec')} "
+              f"(parts={mesh.get('num_parts')}), "
+              f"plans={mesh.get('plans', {}).get('plans')}")
     if args.faults:
         from lux_tpu.utils import faults
 

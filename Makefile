@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -27,10 +27,16 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke race-stress chaos-stress
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
+
+# Regression gate: a fast tiny-graph bench round (CPU-safe, <30s) emits
+# bench_gate.v1 JSON and ratchets against the newest BENCH_r0N.json
+# baseline with per-metric tolerances (LUX_BENCH_GATE_TOL).
+bench-gate:
+	env JAX_PLATFORMS=cpu python tools/bench_gate.py --fast
 
 obs-smoke:
 	python tools/obs_smoke.py

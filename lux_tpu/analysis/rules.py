@@ -583,6 +583,119 @@ class SwallowedException(Rule):
         return out
 
 
+class MetricNameDiscipline(Rule):
+    id = "LUX008"
+    title = "metric-name-discipline"
+    doc = ("metric names must match lux_[a-z0-9_]+(_total|_seconds|"
+           "_bytes)? and handles must not be minted per call: every "
+           "counter/gauge/histogram factory call round-trips the "
+           "registry lock, so creation is banned inside loops, and in "
+           "obs/ code a constant-shaped handle (literal name, no or "
+           "constant labels) must live at module scope")
+
+    _NAME_RE = re.compile(r"lux_[a-z0-9_]+(_total|_seconds|_bytes)?")
+    _FACTORIES = frozenset(("counter", "gauge", "histogram"))
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        bare = self._bare_factory_names(tree)
+        in_obs = "obs/" in ctx.posix_path
+        # One pass with explicit ancestry: (in a def, in a loop) per node.
+        # At most ONE finding per creation call — bad name beats
+        # loop-mint beats module-scope, so each site reads as one defect.
+        stack: List[Tuple[ast.AST, bool, bool]] = [(tree, False, False)]
+        while stack:
+            node, in_def, in_loop = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_def = True
+            elif isinstance(node, (ast.For, ast.While)):
+                in_loop = True
+            elif isinstance(node, ast.Call):
+                f = self._check_creation(node, ctx, bare, in_obs,
+                                         in_def, in_loop)
+                if f is not None:
+                    out.append(f)
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, in_def, in_loop))
+        return out
+
+    def _bare_factory_names(self, tree: ast.Module) -> Set[str]:
+        """Factory names bound by ``from ...metrics import counter, ...``
+        anywhere in the file (engine code imports them function-locally)."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if not (node.module or "").endswith("metrics"):
+                continue
+            names.update(
+                a.asname or a.name for a in node.names
+                if a.name in self._FACTORIES)
+        return names
+
+    def _is_factory(self, node: ast.Call, bare: Set[str]) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in bare
+        name = _dotted(func)
+        if name is None:
+            return False
+        parts = name.split(".")
+        return parts[-1] in self._FACTORIES and "metrics" in parts[:-1]
+
+    @staticmethod
+    def _constant_labels(node: ast.Call) -> bool:
+        """True when the labels argument is absent, None, or a literal
+        dict of literal keys/values — i.e. the handle has a fixed shape
+        and the creation could be hoisted verbatim."""
+        labels: Optional[ast.expr] = None
+        if len(node.args) > 1:
+            labels = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "labels":
+                labels = kw.value
+        if labels is None:
+            return True
+        if isinstance(labels, ast.Constant):
+            return labels.value is None
+        if isinstance(labels, ast.Dict):
+            return all(isinstance(k, ast.Constant) for k in labels.keys) \
+                and all(isinstance(v, ast.Constant) for v in labels.values)
+        return False
+
+    def _check_creation(self, node: ast.Call, ctx: FileContext,
+                        bare: Set[str], in_obs: bool,
+                        in_def: bool, in_loop: bool) -> Optional[Finding]:
+        if not self._is_factory(node, bare):
+            return None
+        name_arg = node.args[0] if node.args else None
+        literal = (name_arg.value
+                   if isinstance(name_arg, ast.Constant)
+                   and isinstance(name_arg.value, str) else None)
+        if literal is not None and not self._NAME_RE.fullmatch(literal):
+            return self.finding(
+                ctx, node,
+                f"metric name {literal!r} breaks the naming contract — "
+                "must match lux_[a-z0-9_]+(_total|_seconds|_bytes)? "
+                "(lux_ prefix, lowercase snake_case, unit suffix for "
+                "counters/durations/sizes)")
+        hoistable = literal is not None and self._constant_labels(node)
+        if in_loop and hoistable:
+            return self.finding(
+                ctx, node,
+                f"metric handle {literal!r} minted inside a loop — each "
+                "factory call takes the registry lock; create the handle "
+                "once outside the loop and reuse it")
+        if in_obs and in_def and hoistable:
+            return self.finding(
+                ctx, node,
+                f"constant-shaped metric handle {literal!r} created per "
+                "call — literal name with no/constant labels belongs at "
+                "module scope; per-call creation churns the registry "
+                "lock on every invocation")
+        return None
+
+
 def all_rules() -> List[Rule]:
     return [
         HostSyncInHotLoop(),
@@ -592,4 +705,5 @@ def all_rules() -> List[Rule]:
         DirectEnvRead(),
         ClockDiscipline(),
         SwallowedException(),
+        MetricNameDiscipline(),
     ]

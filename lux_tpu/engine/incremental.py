@@ -50,6 +50,7 @@ import numpy as np
 from lux_tpu.engine.push import (MultiSourcePushExecutor, PushExecutor,
                                  PushState)
 from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import recorder_for
 from lux_tpu.utils import faults
 
 
@@ -196,6 +197,12 @@ class IncrementalExecutor:
         faults.point("serve.engine.execute")
         state, info = self.warm_state(old_values, removed, inserted,
                                       **init_kw)
+        if recorder is None:
+            # Label the warm-started fixpoint as this engine's run, not
+            # the inner push executor's (the delegate starts/finishes
+            # whatever recorder it is handed).
+            recorder = recorder_for("incremental", self.graph,
+                                    self.program)
         state, iters = self.push.run(max_iters=max_iters, state=state,
                                      chunk=chunk, recorder=recorder)
         return state, iters, info
@@ -243,6 +250,9 @@ class IncrementalExecutor:
                 fsum / max(self.graph.nv * self.multi.k, 1)
             ),
         }
+        if recorder is None:
+            recorder = recorder_for("incremental", self.graph,
+                                    self.program)
         state, iters = self.multi.run(starts, max_iters=max_iters,
                                       chunk=chunk, recorder=recorder,
                                       state=state)

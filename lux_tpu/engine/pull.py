@@ -640,6 +640,9 @@ class PullExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            from lux_tpu.obs import engobs
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne, k=max(self._kreal, 1)))
         if self._kpad:
             padded = run_maybe_fused(
                 self._jrun,

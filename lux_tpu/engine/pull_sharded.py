@@ -31,6 +31,7 @@ from lux_tpu.engine.pull import hard_sync, make_fused_runner, run_maybe_fused
 from lux_tpu.graph.graph import Graph
 from lux_tpu.obs import (
     consume_compile_seconds,
+    engobs,
     note_compile_seconds,
     recorder_for,
 )
@@ -301,13 +302,36 @@ class ShardedPullExecutor:
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
             rec.set_exchange_bytes(
-                self._exchange_bytes_per_iter(), note="all_gather")
-        out = run_maybe_fused(
-            self._jrun, self.step, vals, num_iters, flush_every,
-            self._device_graph, recorder=rec,
-        )
+                self._exchange_bytes_per_iter(), note="all_gather",
+                parts=self.num_parts)
+            self._note_ledger(rec)
+        if engobs.enabled():
+            # Phase-fenced measurement run: exchange/compute split per
+            # iteration. Off (the default) never reaches here, so the
+            # fused program below stays the exact pre-observatory one.
+            out = engobs.run_pull_phased(self, vals, num_iters, rec)
+        else:
+            out = run_maybe_fused(
+                self._jrun, self.step, vals, num_iters, flush_every,
+                self._device_graph, recorder=rec,
+            )
         rec.finish()
         return out
+
+    def _note_ledger(self, rec):
+        """Exchange-ledger and roofline inputs: useful-bytes from the
+        plan's remote-read index, HBM traffic from the byte model."""
+        try:
+            itemsize = np.dtype(self.program.value_dtype).itemsize
+        except (AttributeError, TypeError):
+            itemsize = 4
+        width = max(self._kreal, 1)
+        useful = engobs.useful_exchange(self.sg, width * itemsize)
+        if useful is not None:
+            rec.set_useful_bytes(useful["useful_bytes_per_iter"],
+                                 useful["ratio"])
+        rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+            self.graph.nv, self.graph.ne, itemsize, width))
 
     def gather_values(self, vals) -> np.ndarray:
         """Padded device layout → global (nv, *t) host array."""

@@ -50,6 +50,7 @@ from lux_tpu.graph.graph import Graph
 from lux_tpu.obs import (
     NULL_RECORDER,
     consume_compile_seconds,
+    engobs,
     note_compile_seconds,
     recorder_for,
 )
@@ -638,6 +639,8 @@ class PushExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne))
         state, total, self.sparse_iters = _run_to_fixpoint(
             self._multi, state, max_iters, chunk, recorder=rec
         )
@@ -692,7 +695,7 @@ def _run_to_fixpoint(multi, state, max_iters, chunk, recorder=None):
         # counts is (k,) single-device or psum-replicated (P, k) sharded;
         # row 0 is the global post-step active count either way.
         cnts = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
-        rec.flush(total, frontier_sizes=cnts)
+        rec.flush(total, frontier_sizes=cnts, sparse_flags=fl)
         if last_i == 0 or done_i == 0:
             break
     hard_sync(state.values)
@@ -822,6 +825,8 @@ class MultiSourcePushExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne, k=self.k))
         state, total, _ = _run_to_fixpoint(
             self._multi, state, max_iters, chunk, recorder=rec
         )
@@ -1431,10 +1436,23 @@ class ShardedPushExecutor:
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
             rec.set_exchange_bytes(
-                self.exchange_bytes_per_iter(), note="dense_estimate")
-        state, total, self.sparse_iters = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk, recorder=rec
-        )
+                self.exchange_bytes_per_iter(), note="dense_estimate",
+                parts=self.num_parts)
+            useful = engobs.useful_exchange(self.sg, 5)
+            if useful is not None:
+                rec.set_useful_bytes(useful["useful_bytes_per_iter"],
+                                     useful["ratio"])
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne))
+        if engobs.enabled():
+            # Phase-fenced measurement fixpoint (LUX_ENGOBS); the off
+            # path keeps the exact chunked fused executable below.
+            state, total, self.sparse_iters = engobs.run_push_phased(
+                self, state, max_iters, rec)
+        else:
+            state, total, self.sparse_iters = _run_to_fixpoint(
+                self._multi, state, max_iters, chunk, recorder=rec
+            )
         rec.finish()
         return state, total
 
@@ -1533,15 +1551,22 @@ class ShardedMultiSourcePushExecutor:
         self._step = jax.jit(mapped, donate_argnums=0)
         self._chunk_cache = {}
 
-    def _iter_block(self, state: PushState, dg):
-        """One dense K-lane iteration on this shard's (1, max_nv, K)
-        blocks; returns the new blocks and the local new-frontier count
-        (summed over lanes)."""
-        prog = self.program
+    def _exchange_lanes_block(self, state: PushState):
+        """Exchange bracket: all-gather the (values, frontier) shards
+        into (P*max_nv, K) global tables. Split from the compute bracket
+        so ``phase_step`` can fence the collective separately; the fused
+        ``_iter_block`` composes both, so the traced ops are identical."""
         v = state.values[0]                            # (max_nv, K)
         f = state.frontier[0]
         all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1, self.k)
         all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1, self.k)
+        return all_v, all_f
+
+    def _compute_lanes_block(self, state: PushState, all_v, all_f, dg):
+        """Local-compute bracket: relax this shard's edges against the
+        gathered tables, segment-reduce into local destinations, apply."""
+        prog = self.program
+        v = state.values[0]                            # (max_nv, K)
         sidx = dg["src_pidx"][0]
         src_vals = all_v[sidx]                         # (max_ne, K)
         src_front = all_f[sidx]
@@ -1567,6 +1592,13 @@ class ShardedMultiSourcePushExecutor:
             PushState(new[None], frontier[None]),
             frontier.sum(dtype=jnp.int32),
         )
+
+    def _iter_block(self, state: PushState, dg):
+        """One dense K-lane iteration on this shard's (1, max_nv, K)
+        blocks; returns the new blocks and the local new-frontier count
+        (summed over lanes)."""
+        all_v, all_f = self._exchange_lanes_block(state)
+        return self._compute_lanes_block(state, all_v, all_f, dg)
 
     def _shard_step(self, state: PushState, dg):
         new_state, cnt = self._iter_block(state, dg)
@@ -1633,6 +1665,60 @@ class ShardedMultiSourcePushExecutor:
     def step(self, state: PushState):
         return self._step(state, self._dg)
 
+    def _phase_jits(self):
+        if hasattr(self, "_pjits"):
+            return self._pjits
+        state_spec = PushState(P(PARTS_AXIS), P(PARTS_AXIS))
+
+        def sm(fn, in_specs, out_specs):
+            # check_vma off: the gathered lane tables are replicated by
+            # construction but the static checker cannot infer it.
+            return jax.jit(compat.shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False,
+            ))
+
+        self._pjits = {
+            "exchange": sm(
+                lambda st: self._exchange_lanes_block(st),
+                (state_spec,), (P(), P()),
+            ),
+            "compute": sm(
+                lambda st, av, af, dg: (
+                    lambda ns, cnt: (ns, cnt[None])
+                )(*self._compute_lanes_block(st, av, af, dg)),
+                (state_spec, P(), P(), self._specs),
+                (state_spec, P(PARTS_AXIS)),
+            ),
+        }
+        return self._pjits
+
+    def phase_step(self, state: PushState):
+        """One K-lane iteration as separately-dispatched exchange and
+        compute brackets; returns (new_state, total_active, times) with
+        the mesh-lockstep phase walls. Dense-only engine, so the branch
+        is always "dense". Fencing breaks fusion — measurement mode."""
+        j = self._phase_jits()
+        times = {}
+        with Timer() as t:
+            all_v, all_f = hard_sync(j["exchange"](state))
+        times["loadTime"] = t.elapsed
+        with Timer() as t:
+            new_state, cnt = hard_sync(
+                j["compute"](state, all_v, all_f, self._dg)
+            )
+        times["compTime"] = t.elapsed
+        times["branch"] = "dense"
+        total = int(np.asarray(jax.device_get(cnt)).sum())
+        return new_state, total, times
+
+    def warmup_phases(self, state: PushState):
+        """Compile both phase executables outside any timed region
+        (``state`` is read, never donated)."""
+        j = self._phase_jits()
+        all_v, all_f = j["exchange"](state)
+        hard_sync(j["compute"](state, all_v, all_f, self._dg))
+
     def run(
         self,
         starts,
@@ -1654,10 +1740,23 @@ class ShardedMultiSourcePushExecutor:
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
             rec.set_exchange_bytes(
-                self.exchange_bytes_per_iter(), note="dense_estimate")
-        state, total, _ = _run_to_fixpoint(
-            self._multi, state, max_iters, chunk, recorder=rec
-        )
+                self.exchange_bytes_per_iter(), note="dense_estimate",
+                parts=self.num_parts)
+            useful = engobs.useful_exchange(self.sg, 5 * self.k)
+            if useful is not None:
+                rec.set_useful_bytes(useful["useful_bytes_per_iter"],
+                                     useful["ratio"])
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne, k=self.k))
+        if engobs.enabled():
+            # Phase-fenced measurement fixpoint (LUX_ENGOBS); off keeps
+            # the exact chunked fused executable below.
+            state, total, _ = engobs.run_push_phased(
+                self, state, max_iters, rec)
+        else:
+            state, total, _ = _run_to_fixpoint(
+                self._multi, state, max_iters, chunk, recorder=rec
+            )
         rec.finish()
         return state, total
 

@@ -397,6 +397,9 @@ class TiledPullExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            from lux_tpu.obs import engobs
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne))
         internal = run_maybe_fused(
             self._jrun, self._step, internal, num_iters, flush_every,
             *self._step_args, recorder=rec,

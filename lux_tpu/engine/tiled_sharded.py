@@ -50,6 +50,7 @@ from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
 from lux_tpu.obs import (
     consume_compile_seconds,
+    engobs,
     note_compile_seconds,
     recorder_for,
 )
@@ -292,6 +293,13 @@ class ShardedTiledExecutor:
         sh = parts_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), sh)
 
+        # Remote-read index (exchange ledger): which global src 128-blocks
+        # each part's strips/tail actually gather, collected while the
+        # host-side plan arrays are alive. Block granularity — the value
+        # exchange is row-wise, but a block is the finest unit the tiled
+        # gather addresses.
+        read_blocks = [set() for _ in range(pcount)]
+
         slevels = []
         for lev in plan.levels:
             rpb = BLOCK // lev.r
@@ -323,6 +331,9 @@ class ShardedTiledExecutor:
                 k = max(i1 - i0, 0)
                 st[p, :k] = lev.strips[i0:i1]
                 co[p, :k] = lev.cols[i0:i1]
+                if k:
+                    read_blocks[p].update(
+                        np.unique(lev.cols[i0:i1]).tolist())
                 b = np.searchsorted(
                     lev.rows[i0:i1], np.arange(nrb_global + 1, dtype=np.int64)
                 )
@@ -392,6 +403,8 @@ class ShardedTiledExecutor:
             eidx = _ranges_to_indices(starts, lens)
             sb[p, :m] = plan.tail_sb[eidx]
             lane[p, :m] = plan.tail_lane[eidx]
+            if m:
+                read_blocks[p].update(np.unique(sb[p, :m]).tolist())
             rp = np.full(self.max_nv + 1, m, np.int64)
             np.cumsum(lens, out=rp[1 : nvloc + 1])
             rp[0] = 0
@@ -404,6 +417,17 @@ class ShardedTiledExecutor:
         xmax = max((a.shape[0] for a in s0s), default=0)
         cs_t = c_tail // BLOCK
         _warn_big_table(k2 * (cs_t + 1) + 1, "tail")
+
+        counts = np.zeros((pcount, pcount), np.int64)
+        for p, blocks in enumerate(read_blocks):
+            if blocks:
+                owners = part.owner[np.fromiter(
+                    blocks, np.int64, len(blocks))]
+                counts[p] += np.bincount(
+                    owners, minlength=pcount).astype(np.int64) * BLOCK
+        # (P, P) rows-read matrix in value rows, same shape/meaning as
+        # ShardedGraph.remote_read_counts (engobs exchange ledger).
+        self._remote_read_counts = counts
 
         self.shybrid = ShardedHybrid(
             levels=tuple(slevels),
@@ -626,11 +650,28 @@ class ShardedTiledExecutor:
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
             rec.set_exchange_bytes(
-                self._exchange_bytes_per_iter(vals), note="all_gather")
-        out = run_maybe_fused(
-            self._jrun, self._step, vals, num_iters, flush_every,
-            self._shard_args, self._replicated, recorder=rec,
-        )
+                self._exchange_bytes_per_iter(vals), note="all_gather",
+                parts=self.num_parts)
+            counts = getattr(self, "_remote_read_counts", None)
+            if counts is not None:
+                p = self.num_parts
+                exchanged = p * (p - 1) * self.max_nv
+                useful_rows = int(counts.sum() - np.trace(counts))
+                if exchanged:
+                    rec.set_useful_bytes(
+                        useful_rows * int(vals.dtype.itemsize),
+                        useful_rows / exchanged)
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne, int(vals.dtype.itemsize)))
+        if engobs.enabled():
+            # Phase-fenced measurement run (LUX_ENGOBS); the off path
+            # keeps the exact fused program below.
+            out = engobs.run_pull_phased(self, vals, num_iters, rec)
+        else:
+            out = run_maybe_fused(
+                self._jrun, self._step, vals, num_iters, flush_every,
+                self._shard_args, self._replicated, recorder=rec,
+            )
         rec.finish()
         return out
 

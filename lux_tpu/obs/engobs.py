@@ -1,0 +1,173 @@
+"""Engine performance observatory (``LUX_ENGOBS=1``).
+
+Three measurement surfaces the sharded engines could not report before:
+
+- **Phase timing.** ``run_pull_phased`` / ``run_push_phased`` drive a
+  run through the executor's ``phase_step`` — separately-dispatched,
+  hard-synced sub-iteration brackets — so every iteration splits into
+  exchange (all_gather/collective) wall time vs local compute wall
+  time. Fencing breaks XLA fusion, so this is a measurement mode: with
+  ``LUX_ENGOBS`` unset or ``0`` the executors dispatch the exact same
+  fused programs as before this module existed (zero added compiles,
+  asserted by the recompile sentinel in tests/test_engobs.py).
+- **Exchange ledger.** ``useful_exchange`` reads the partition plan's
+  remote-read index (ShardedGraph.remote_read_counts — the same
+  structure the ROADMAP item-1 needed-rows optimization will consume)
+  and prices the all_gather against the rows some receiving part
+  actually reads: ``ratio`` is the fraction of exchanged bytes that
+  were not waste.
+- **Roofline inputs.** ``hbm_bytes_per_iter`` is the first-order
+  per-iteration HBM traffic model every engine reports so
+  obs/report.py can place a run against the HBM/ICI peaks.
+
+The module also keeps a process-wide "latest per engine" table
+(``note``/``latest``) that /statusz's mesh block publishes, so a serving
+process shows the live phase split and useful-bytes ratio per engine
+without a metrics dump.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..utils import flags
+from ..utils.locks import make_lock
+from ..utils.timing import Timer
+
+_lock = make_lock("obs.engobs")
+_latest: Dict[str, dict] = {}
+
+
+def enabled() -> bool:
+    """True when ``LUX_ENGOBS`` asks for phase-fenced measurement runs.
+
+    Off is the default and costs one flag read per ``run()``: the
+    executors never build the phase executables, so the fused program —
+    and the zero-recompile serving contract — is bit-for-bit the
+    pre-observatory one.
+    """
+    return flags.get_bool("LUX_ENGOBS")
+
+
+def note(engine: str, **fields):
+    """Merge ``fields`` into the process-wide latest-telemetry table for
+    ``engine`` (phase split, useful-bytes ratio, frontier density)."""
+    with _lock:
+        d = _latest.setdefault(engine, {})
+        d.update(fields)
+
+
+def latest() -> Dict[str, dict]:
+    """Copy of the latest per-engine telemetry (the /statusz mesh-block
+    ``engobs`` entry; {} until an instrumented run has happened)."""
+    with _lock:
+        return {k: dict(v) for k, v in _latest.items()}
+
+
+def reset():
+    with _lock:
+        _latest.clear()
+
+
+# -- exchange ledger -------------------------------------------------------
+
+
+def useful_exchange(sg, row_bytes: int) -> Optional[dict]:
+    """Price one iteration's all_gather against the remote-read index.
+
+    Every part broadcasts its full ``max_nv``-row shard to the P-1
+    others; only the rows some receiver's local edges actually index are
+    useful. Returns ``{useful_rows, exchanged_rows, useful_bytes_per_iter,
+    ratio}`` or None when the plan's edge arrays were already released
+    (ShardedGraph.release_edge_arrays) and the index was never built.
+    """
+    counts = sg.remote_read_counts()
+    if counts is None:
+        return None
+    p = sg.num_parts
+    exchanged_rows = p * (p - 1) * sg.max_nv
+    # Off-diagonal entries only: a part's reads of its own rows never
+    # cross the interconnect.
+    useful_rows = int(counts.sum() - counts.trace())
+    ratio = useful_rows / exchanged_rows if exchanged_rows else 0.0
+    return {
+        "useful_rows": useful_rows,
+        "exchanged_rows": exchanged_rows,
+        "useful_bytes_per_iter": useful_rows * int(row_bytes),
+        "ratio": ratio,
+    }
+
+
+# -- roofline input model --------------------------------------------------
+
+
+def hbm_bytes_per_iter(nv: int, ne: int, value_bytes: int = 4,
+                       k: int = 1) -> int:
+    """First-order HBM traffic of one dense iteration: per edge one
+    gathered value row plus one int32 index read, per vertex one read
+    and one write of the value row plus the degree read. A model, not a
+    measurement — report.py labels the resulting fractions as such."""
+    row = value_bytes * max(k, 1)
+    return ne * (row + 4) + nv * (3 * row + 4)
+
+
+# -- phase-fenced runners --------------------------------------------------
+
+
+def _split(times: dict) -> tuple:
+    """(exchange_s, compute_s) from a phase_step times dict. The sharded
+    pull family names its collective bracket "exchange"; the sharded
+    push family's all_gather lives in "loadTime"."""
+    exchange = 0.0
+    compute = 0.0
+    for key, val in times.items():
+        if not isinstance(val, (int, float)):
+            continue
+        if key in ("exchange", "loadTime"):
+            exchange += val
+        else:
+            compute += val
+    return exchange, compute
+
+
+def run_pull_phased(ex, vals, num_iters: int, rec):
+    """Fixed-iteration phase-fenced loop for the sharded pull family
+    (ShardedPullExecutor / ShardedTiledExecutor): one exchange/compute
+    split per iteration via ``phase_step``. Returns the final values."""
+    if not hasattr(ex, "_pjits"):
+        # First phase_step compiles every phase executable; keep that
+        # out of the per-iteration walls (phase jits do not donate, so
+        # the throwaway step leaves ``vals`` intact).
+        with Timer() as t:
+            ex.phase_step(vals)
+        rec.record_compile(t.elapsed)
+    for i in range(int(num_iters)):
+        vals, times = ex.phase_step(vals)
+        exchange, compute = _split(times)
+        rec.record_phase(i + 1, exchange, compute, detail=times)
+    return vals
+
+
+def run_push_phased(ex, state, max_iters, rec):
+    """Phase-fenced fixpoint for the sharded push engine: per-iteration
+    exchange/compute split plus the frontier count and dense/sparse
+    branch from ``phase_step``. Returns (state, iterations_run,
+    sparse_iterations)."""
+    with Timer() as t:
+        ex.warmup_phases(state)
+    rec.record_compile(t.elapsed)
+    total = 0
+    sparse_total = 0
+    limit = None if max_iters is None else int(max_iters)
+    while limit is None or total < limit:
+        state, cnt, times = ex.phase_step(state)
+        exchange, compute = _split(times)
+        branch = times.get("branch")
+        if isinstance(branch, str) and branch.startswith("sparse"):
+            sparse_total += 1
+        total += 1
+        rec.record_phase(total, exchange, compute, frontier=cnt,
+                         branch=branch, detail=times)
+        if cnt == 0:
+            break
+    return state, total, sparse_total

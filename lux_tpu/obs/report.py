@@ -21,6 +21,45 @@ from . import metrics
 _LOG_ROWS_HEAD = 24
 _LOG_ROWS_TAIL = 8
 
+# Roofline peaks. HBM matches bench.py's v5e single-chip figure; ICI is
+# the per-chip v5e interconnect estimate (4 links x ~46.5 GB/s usable).
+# Both are ceilings for *fractions* — the ledger labels results as
+# model-derived, not measured, on CPU meshes.
+HBM_PEAK_GBPS = 819.0
+ICI_PEAK_GBPS = 186.0
+
+
+def roofline(summary: dict) -> dict:
+    """Achieved-vs-peak HBM and ICI fractions for one run summary.
+
+    HBM: the engine's first-order bytes-per-iteration model
+    (``hbm_bytes_per_iter``, from engobs.hbm_bytes_per_iter) over execute
+    time. ICI: exchange bytes over exchange time — phase-measured
+    exchange seconds when the run was phase-fenced (LUX_ENGOBS), else
+    total execute time (a lower bound on the fraction) — divided across
+    the mesh's parts, since per-iter exchange bytes count all P shards'
+    collectives while the peak is per chip.
+    """
+    out = {}
+    iters = summary.get("num_iters") or 0
+    exec_s = summary.get("execute_s") or 0.0
+    hbm = summary.get("hbm_bytes_per_iter")
+    if hbm and iters and exec_s > 0:
+        gbps = hbm * iters / exec_s / 1e9
+        out["hbm_gbps"] = gbps
+        out["hbm_frac"] = gbps / HBM_PEAK_GBPS
+    exch = summary.get("exchange_bytes_per_iter")
+    if exch and iters:
+        phases = summary.get("phases") or {}
+        exch_s = phases.get("exchange_s") or exec_s
+        parts = summary.get("parts") or 1
+        if exch_s > 0:
+            gbps = exch * iters / exch_s / 1e9 / max(parts, 1)
+            out["ici_gbps_per_chip"] = gbps
+            out["ici_frac"] = gbps / ICI_PEAK_GBPS
+            out["ici_measured"] = bool(phases)
+    return out
+
 
 def _format_table(summary: dict) -> str:
     lines = [
@@ -30,9 +69,29 @@ def _format_table(summary: dict) -> str:
         "execute={execute_s:.4f}s gteps={gteps:.4f}".format(**summary),
     ]
     if summary.get("exchange_bytes_per_iter"):
+        line = ("  exchange: {exchange_bytes_per_iter} B/iter, "
+                "{exchange_bytes_total} B total".format(**summary))
+        if summary.get("useful_bytes_per_iter") is not None:
+            line += " (useful {useful_bytes_per_iter} B/iter, " \
+                "ratio {useful_ratio:.3f})".format(**summary)
+        lines.append(line)
+    if summary.get("phases"):
         lines.append(
-            "  exchange: {exchange_bytes_per_iter} B/iter, "
-            "{exchange_bytes_total} B total".format(**summary))
+            "  phases: exchange={exchange_s:.4f}s compute={compute_s:.4f}s "
+            "exchange_frac={exchange_frac:.3f}".format(**summary["phases"]))
+    roof = summary.get("roofline")
+    if roof:
+        bits = []
+        if "hbm_frac" in roof:
+            bits.append("HBM {hbm_gbps:.1f} GB/s ({hbm_frac:.3f} of "
+                        "peak)".format(**roof))
+        if "ici_frac" in roof:
+            bits.append("ICI {ici_gbps_per_chip:.1f} GB/s/chip "
+                        "({ici_frac:.3f} of peak{})".format(
+                            "" if roof.get("ici_measured")
+                            else ", bound", **roof))
+        if bits:
+            lines.append("  roofline: " + "; ".join(bits))
     rows = summary.get("iterations") or []
     if rows:
         lines.append(
@@ -60,6 +119,9 @@ def _format_row(r: dict) -> str:
 
 
 def finalize(summary: dict):
+    roof = roofline(summary)
+    if roof:
+        summary["roofline"] = roof
     log = get_logger("perf")
     log.info("%s", _format_table(summary))
     path = flags.get("LUX_METRICS")

@@ -57,11 +57,15 @@ _MAX_OPEN = 1024
 _open: "OrderedDict[str, dict]" = OrderedDict()
 _sinks: List[Callable[[dict], None]] = []
 
-# Span-latency buckets: serve phases run ~10us (cache probe) to seconds
-# (cold engine sweep); the default seconds-oriented bounds lose the
-# bottom three decades.
+# Span-latency buckets: engine phases run ~10us (a fenced exchange on a
+# tiny mesh) through serve phases to seconds (cold engine sweep). The
+# old bounds jumped 1e-4 -> 5e-4 -> 1e-3, collapsing the sub-millisecond
+# band the engine observatory lives in into three coarse buckets; the
+# 2-5-10 ladder below keeps quantile interpolation within ~2.5x of truth
+# down to 10us while the top decades stay serving-scale.
 SPAN_BUCKETS = (
-    1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+    1e-5, 2e-5, 5e-5, 1e-4, 2e-4, 5e-4, 1e-3, 2e-3, 5e-3, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0,
     float("inf"),
 )
 

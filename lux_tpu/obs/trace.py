@@ -160,6 +160,21 @@ def async_pair(name: str, id_: str, t0: float, t1: float, cat: str = "lux",
     async_end(name, id_, cat, None, ts=(t1 - _EPOCH) * 1e6)
 
 
+def counter(name: str, values: dict, cat: str = "lux", ts: float = None):
+    """Counter event (ph "C"): Perfetto renders each key of ``values`` as
+    a stacked track under ``name``. The engine observatory streams
+    per-iteration series this way (exchange/compute seconds, frontier
+    density, useful-bytes ratio); ``ts`` is an optional perf_counter
+    stamp for retrospective points."""
+    if _writer is None:
+        return
+    ev = _base(name, cat)
+    ev.update(ph="C", ts=_now_us() if ts is None else (ts - _EPOCH) * 1e6,
+              args={k: v for k, v in values.items()
+                    if isinstance(v, (int, float))})
+    _emit(ev)
+
+
 def instant(name: str, cat: str = "lux", args: dict = None):
     if _writer is None:
         return

@@ -171,6 +171,38 @@ class ShardedGraph:
         self.src_pidx = self.src_global = None
         self.dst_local = self.edge_mask = self.weights = None
 
+    # -- remote-read index ------------------------------------------------
+
+    def remote_read_counts(self) -> Optional[np.ndarray]:
+        """(P, P) int64 matrix C where ``C[q, p]`` is the number of
+        *distinct* rows of part p's padded shard table that part q's real
+        edges gather — the needed-rows index: row q of the all_gather is
+        only useful to part q up to ``C[q, :].sum()`` rows out of
+        ``P * max_nv`` exchanged. The exchange ledger (obs/engobs.py)
+        prices useful-bytes from the off-diagonal, and the ROADMAP item-1
+        needed-rows exchange will send exactly these rows.
+
+        Computed once from ``src_pidx``/``edge_mask`` and cached on the
+        instance; returns the cached matrix after
+        ``release_edge_arrays``, or None when the arrays were released
+        before the index was ever built.
+        """
+        cached = getattr(self, "_remote_read_counts", None)
+        if cached is not None:
+            return cached
+        if self.src_pidx is None or self.edge_mask is None:
+            return None
+        P = self.num_parts
+        counts = np.zeros((P, P), dtype=np.int64)
+        for q in range(P):
+            rows = np.unique(self.src_pidx[q][self.edge_mask[q]])
+            if rows.size:
+                counts[q] += np.bincount(
+                    rows // self.max_nv, minlength=P
+                ).astype(np.int64)
+        self._remote_read_counts = counts
+        return counts
+
     # -- push-direction (CSR-by-global-src) view -------------------------
 
     def build_push_csr(self):

@@ -42,7 +42,7 @@ import numpy as np
 
 from lux_tpu.graph.graph import Graph
 from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
-from lux_tpu.obs import flight, metrics, slo, spans
+from lux_tpu.obs import engobs, flight, metrics, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
 from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
@@ -1007,6 +1007,10 @@ class Session:
             "num_parts": self.meshspec.num_parts,
             "pool_entries": by_shape,
             "plans": plan_cache().stats(),
+            # Latest engine-observatory telemetry per engine: phase
+            # split, useful-bytes ratio, frontier density ({} until an
+            # instrumented run has happened in this process).
+            "engobs": engobs.latest(),
         }
 
     def mesh_exchange_bytes(self) -> dict:

@@ -160,6 +160,11 @@ define("LUX_FLIGHT_CAPACITY", 256,
        "engine iteration records kept for postmortems", kind="int")
 define("LUX_STATUSZ_WINDOWS", "60,300",
        "/statusz rolling SLO window lengths in seconds, comma-separated")
+define("LUX_ENGOBS", False,
+       "engine performance observatory (obs/engobs.py): run sharded "
+       "executors through phase-fenced steps splitting exchange vs "
+       "compute time per iteration; off keeps the exact fused programs",
+       kind="bool")
 
 # Backend / native toolchain (utils/platform.py, native/build.py)
 define("LUX_PLATFORM", None,
@@ -205,6 +210,14 @@ define("LUX_BENCH_SUITE", True,
        "bench.py: run the full suite (0 = headline only)", kind="bool")
 define("LUX_BENCH_DEADLINE", 480.0,
        "bench.py total seconds of bench budget", kind="float")
+define("LUX_BENCH_GATE_SCALE", 10,
+       "tools/bench_gate.py --fast R-MAT scale (tiny graph so the gate "
+       "fits in make verify)", kind="int")
+define("LUX_BENCH_GATE_TOL", 0.4,
+       "bench_gate relative regression tolerance per metric (generous: "
+       "sub-ms CPU fast-mode iterations jitter ~25% run to run; tighten "
+       "per claim with --tol)",
+       kind="float")
 
 # Static analysis, IR tier (analysis/ir.py, analysis/planck.py,
 # serve/pool.py)

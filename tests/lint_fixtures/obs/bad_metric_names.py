@@ -1,0 +1,33 @@
+"""LUX008 fixtures: metric handles violating the name or creation
+discipline. Names must match lux_[a-z0-9_]+(_total|_seconds|_bytes)?;
+handles must not be minted per call (each creation round-trips the
+registry lock) — never in a loop, and in obs/ code a constant-shaped
+handle must live at module scope."""
+from lux_tpu.obs import metrics
+
+GOOD_TOP = metrics.counter("lux_requests_total")
+
+
+def count_batches(batches):
+    for b in batches:
+        c = metrics.counter("lux_batches_total")  # expect: LUX008
+        c.inc(len(b))
+
+
+def watch(queue):
+    while queue:
+        metrics.gauge("lux_queue_depth").set(len(queue))  # expect: LUX008
+        queue.pop()
+
+
+def bad_names():
+    metrics.counter("requests_total")  # expect: LUX008
+    metrics.gauge("lux_QueueDepth")  # expect: LUX008
+    metrics.histogram("lux-latency-seconds")  # expect: LUX008
+
+
+def per_call_handle():
+    # Constant name, constant labels: nothing stops this living at
+    # module scope, so every call churns the registry lock for nothing.
+    h = metrics.histogram("lux_step_seconds", {"phase": "step"})  # expect: LUX008
+    return h

@@ -1,0 +1,20 @@
+"""LUX008-clean metric creation: disciplined names minted once at
+module scope, plus the legal function-scope shapes — dynamic label
+values (the handle genuinely varies per call) and non-literal names
+(WAL replay counters resolved from records)."""
+from lux_tpu.obs import metrics
+
+REQUESTS = metrics.counter("lux_requests_total")
+DEPTH = metrics.gauge("lux_queue_depth")
+LAT = metrics.histogram("lux_iteration_seconds")
+BYTES = metrics.counter("lux_exchange_bytes")
+
+
+def per_engine(engine):
+    # Dynamic labels: one handle per engine value cannot be hoisted.
+    return metrics.counter("lux_iterations_total", {"engine": engine})
+
+
+def replay(record):
+    # Non-literal name: the registry key comes from data, not code.
+    return metrics.counter(record["name"], record["labels"])

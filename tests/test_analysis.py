@@ -34,6 +34,7 @@ BAD_FIXTURES = (
     "lux_tpu/bad_envflag.py",
     "serve/bad_clock.py",
     "serve/bad_swallow.py",
+    "obs/bad_metric_names.py",
 )
 GOOD_FIXTURES = (
     "engine/good_host_sync.py",
@@ -42,6 +43,7 @@ GOOD_FIXTURES = (
     "lux_tpu/good_envflag.py",
     "serve/good_clock.py",
     "serve/good_swallow.py",
+    "obs/good_metric_names.py",
 )
 
 

@@ -1,0 +1,316 @@
+"""Engine performance observatory (LUX_ENGOBS): the remote-read index,
+phase-fenced exchange/compute timing on the sharded engines, the
+zero-overhead-off contract (sentinel-asserted), the bench regression
+gate, and the supporting metrics/statusz surfaces."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine.pull_sharded import ShardedPullExecutor
+from lux_tpu.engine.push import ShardedPushExecutor
+from lux_tpu.graph import generate
+from lux_tpu.models.pagerank import PageRank, reference_pagerank
+from lux_tpu.models.sssp import SSSP, reference_sssp
+from lux_tpu.obs import engobs, metrics, report
+from lux_tpu.obs.spans import SPAN_BUCKETS
+from lux_tpu.parallel.mesh import make_mesh
+from lux_tpu.parallel.shard import ShardedGraph
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+
+
+def _load_bench_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(REPO, "tools", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _last_run(path):
+    return report.read_last(path)
+
+
+# -- remote-read index (exchange ledger input) ----------------------------
+
+
+def test_remote_read_counts_matches_bruteforce():
+    g = generate.gnp(300, 2400, seed=31)
+    sg = ShardedGraph.build(g, 4)
+    counts = sg.remote_read_counts()
+    assert counts is not None and counts.shape == (4, 4)
+    # Brute force: part q's distinct gathered rows, bucketed by owner.
+    want = np.zeros((4, 4), dtype=np.int64)
+    for q in range(4):
+        rows = np.unique(sg.src_pidx[q][sg.edge_mask[q]])
+        for r in rows:
+            want[q, int(r) // sg.max_nv] += 1
+    np.testing.assert_array_equal(counts, want)
+    # Cached: second call returns the same object without recomputing.
+    assert sg.remote_read_counts() is counts
+
+
+def test_useful_exchange_prices_off_diagonal():
+    g = generate.gnp(300, 2400, seed=32)
+    sg = ShardedGraph.build(g, 4)
+    got = engobs.useful_exchange(sg, row_bytes=8)
+    assert got is not None
+    counts = sg.remote_read_counts()
+    useful = int(counts.sum() - counts.trace())
+    assert got["useful_rows"] == useful
+    assert got["exchanged_rows"] == 4 * 3 * sg.max_nv
+    assert got["useful_bytes_per_iter"] == useful * 8
+    assert 0.0 < got["ratio"] <= 1.0
+
+
+# -- phase-fenced runs on the 8-virtual-device mesh -----------------------
+
+
+def test_pull_sharded_phase_split_recorded(tmp_path, monkeypatch):
+    mpath = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("LUX_METRICS", mpath)
+    monkeypatch.setenv("LUX_ENGOBS", "1")
+    engobs.reset()
+    g = generate.gnp(400, 3200, seed=33)
+    ex = ShardedPullExecutor(g, PageRank(), mesh=make_mesh(4))
+    got = ex.gather_values(ex.run(6))
+    np.testing.assert_allclose(got, reference_pagerank(g, 6), rtol=2e-5)
+
+    run = _last_run(mpath)
+    assert run["engine"] == "pull_sharded" and run["parts"] == 4
+    ph = run["phases"]
+    assert ph["exchange_s"] > 0 and ph["compute_s"] > 0
+    assert 0.0 < ph["exchange_frac"] < 1.0
+    assert len(run["iterations"]) == 6
+    assert all(r["exchange_s"] >= 0 and r["compute_s"] > 0
+               for r in run["iterations"])
+    # Exchange ledger rode along: useful bytes never exceed exchanged.
+    assert 0.0 < run["useful_ratio"] <= 1.0
+    assert run["useful_bytes_per_iter"] <= run["exchange_bytes_per_iter"]
+    assert run["hbm_bytes_per_iter"] > 0
+    # /statusz's latest-table view carries the same split.
+    latest = engobs.latest()["pull_sharded"]
+    assert latest["run_exchange_frac"] == pytest.approx(
+        ph["exchange_frac"])
+
+
+def test_push_sharded_phase_split_and_frontier(tmp_path, monkeypatch):
+    mpath = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("LUX_METRICS", mpath)
+    monkeypatch.setenv("LUX_ENGOBS", "1")
+    engobs.reset()
+    g = generate.gnp(300, 2000, seed=34, weighted=True)
+    ex = ShardedPushExecutor(g, SSSP(), mesh=make_mesh(4))
+    state, iters = ex.run(start=0)
+    np.testing.assert_allclose(
+        ex.gather_values(state), reference_sssp(g, 0), rtol=1e-6)
+
+    run = _last_run(mpath)
+    assert run["engine"] == "push_sharded"
+    assert run["phases"]["exchange_s"] > 0
+    assert run["phases"]["compute_s"] > 0
+    # Every phase-fenced iteration carries frontier + branch.
+    assert len(run["iterations"]) == run["num_iters"] == iters
+    for r in run["iterations"]:
+        assert r["frontier"] is not None
+        assert r["branch"] == "dense" or r["branch"].startswith("sparse")
+    assert run["iterations"][-1]["frontier"] == 0
+
+
+def test_engobs_off_is_default_fused_path_with_zero_recompiles(monkeypatch):
+    from lux_tpu.analysis.sentinel import RecompileSentinel
+
+    monkeypatch.delenv("LUX_ENGOBS", raising=False)
+    assert not engobs.enabled()
+    sent = RecompileSentinel("engobs-off")
+    if not sent.available:
+        sent.close()
+        pytest.skip("jax monitoring hook unavailable in this jax")
+    try:
+        g = generate.gnp(400, 3200, seed=33)
+        ex = ShardedPullExecutor(g, PageRank(), mesh=make_mesh(4))
+        with sent.expect("pull"):
+            base = ex.gather_values(ex.run(6))
+        with sent.watch("pull"):
+            again = ex.gather_values(ex.run(6))
+        sent.assert_zero_recompiles()
+        # Off path is the exact pre-observatory fused program: bitwise
+        # stable across runs, no phase executables ever built.
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(again))
+        assert not hasattr(ex, "_pjits")
+    finally:
+        sent.close()
+
+    # Measurement mode changes dispatch granularity, not the math.
+    monkeypatch.setenv("LUX_ENGOBS", "1")
+    ex2 = ShardedPullExecutor(g, PageRank(), mesh=make_mesh(4))
+    phased = ex2.gather_values(ex2.run(6))
+    np.testing.assert_allclose(phased, base, rtol=1e-6, atol=1e-12)
+
+
+# -- bench regression gate ------------------------------------------------
+
+
+def _doc(metrics_map, **ctx):
+    context = {"mode": "fast", "scale": 10, "ef": 8, "layout": "tiled",
+               "platform": "cpu"}
+    context.update(ctx)
+    return {"schema": "bench_gate.v1", "mode": context["mode"],
+            "context": context, "cmd": "test", "metrics": metrics_map}
+
+
+def test_bench_gate_compare_directions():
+    bg = _load_bench_gate()
+    base = {"headline_gteps": 1.0, "sssp_rmat.ms_per_iter": 10.0}
+    # Better on both axes (throughput up, latency down) passes.
+    rows, ok = bg.compare(
+        {"headline_gteps": 1.2, "sssp_rmat.ms_per_iter": 8.0}, base, 0.1)
+    assert ok and all(r["ok"] for r in rows)
+    by = {r["metric"]: r for r in rows}
+    assert by["headline_gteps"]["better"] == "higher"
+    assert by["sssp_rmat.ms_per_iter"]["better"] == "lower"
+    # Throughput collapse beyond tolerance fails.
+    _, ok = bg.compare(
+        {"headline_gteps": 0.5, "sssp_rmat.ms_per_iter": 10.0}, base, 0.1)
+    assert not ok
+    # Latency blowup beyond tolerance fails.
+    _, ok = bg.compare(
+        {"headline_gteps": 1.0, "sssp_rmat.ms_per_iter": 15.0}, base, 0.1)
+    assert not ok
+    # Within tolerance passes in both directions.
+    rows, ok = bg.compare(
+        {"headline_gteps": 0.95, "sssp_rmat.ms_per_iter": 10.5}, base, 0.1)
+    assert ok and len(rows) == 2
+
+
+def test_bench_gate_legacy_baseline_fails_closed():
+    bg = _load_bench_gate()
+    cur = _doc({})["context"]
+    ok, reason = bg.comparable(cur, {"mode": None, "scale": 16,
+                                     "ef": None, "layout": "tiled",
+                                     "platform": None})
+    assert not ok and "mode" in reason
+    ok, _ = bg.comparable(cur, dict(cur))
+    assert ok
+
+
+def test_bench_gate_seeded_regression_exits_nonzero(tmp_path):
+    base = _doc({"headline_gteps": 1.0, "achieved_gbps": 100.0})
+    cur = _doc({"headline_gteps": 0.4, "achieved_gbps": 100.0})
+    bpath, cpath = str(tmp_path / "base.json"), str(tmp_path / "cur.json")
+    with open(bpath, "w") as f:
+        json.dump(base, f)
+    with open(cpath, "w") as f:
+        json.dump(cur, f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "--replay", cpath, "--baseline", bpath, "--tol", "0.25"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1]
+                         .split("BENCH_GATE ", 1)[1])
+    assert summary["ok"] is False and summary["compared"] == 2
+    assert "REGRESSED" in proc.stdout
+    # Same doc replayed against itself passes with rc 0.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "--replay", bpath, "--baseline", bpath],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bench_r06_artifact_is_gate_lineage():
+    path = os.path.join(REPO, "BENCH_r06.json")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "bench_gate.v1"
+    assert doc["context"]["mode"] == "fast"
+    assert doc["metrics"]["headline_gteps"] > 0
+    assert "roofline" in doc
+
+
+# -- fine-grained span buckets --------------------------------------------
+
+
+def test_span_buckets_resolve_submillisecond_phases():
+    metrics.reset()
+    h = metrics.histogram("lux_span_seconds", {"span": "t.exchange"},
+                          buckets=SPAN_BUCKETS)
+    for _ in range(100):
+        h.observe(1.5e-4)          # 150 us: a realistic exchange fence
+    q50 = h.quantile(0.5)
+    # The 2-5-10 ladder brackets 150 us by [100 us, 200 us]: the estimate
+    # may not leave that bucket (the old decade ladder put everything
+    # below 1 ms into one bin and reported ~ms-scale medians).
+    assert 1e-4 <= q50 <= 2e-4
+    h2 = metrics.histogram("lux_span_seconds", {"span": "t.compute"},
+                           buckets=SPAN_BUCKETS)
+    for _ in range(100):
+        h2.observe(3.0e-5)         # 30 us compute bracket
+    assert 2e-5 <= h2.quantile(0.5) <= 5e-5
+
+
+# -- prometheus rendering of the new per-iteration metrics ----------------
+
+
+def test_render_prometheus_escapes_mesh_shape_labels():
+    metrics.reset()
+    metrics.gauge("lux_exchange_useful_ratio",
+                  {"engine": 'pull"shard\\ed\n2x4'}).set(0.5)
+    out = metrics.render_prometheus()
+    line = next(l for l in out.splitlines()
+                if l.startswith("lux_exchange_useful_ratio{"))
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n2x4" not in line              # raw newline must not survive
+
+
+def test_counter_handles_survive_hot_swap():
+    # A hot-swap tears down engines and mints fresh recorder handles; the
+    # registry must hand back the same family so counters stay monotone.
+    metrics.reset()
+    c1 = metrics.counter("lux_iterations_total", {"engine": "pull_sharded"})
+    c1.inc(5)
+    c2 = metrics.counter("lux_iterations_total", {"engine": "pull_sharded"})
+    assert c2 is c1
+    c2.inc(3)
+    assert c1.value == 8
+
+
+# -- /statusz mesh block --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_statusz_mesh_block_schema_with_and_without_mesh(monkeypatch):
+    from lux_tpu.serve import ServeConfig, Session
+
+    def cfg(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("window_s", 0.01)
+        kw.setdefault("max_queue", 64)
+        kw.setdefault("pagerank_iters", 4)
+        return ServeConfig(**kw)
+
+    g = generate.gnp(200, 1200, seed=35)
+    engobs.reset()
+    engobs.note("pull_sharded", run_exchange_frac=0.4, useful_ratio=0.7)
+    monkeypatch.setenv("LUX_SERVE_MESH", "2x2")
+    with Session(g, cfg(), warm=False) as s:
+        m = s.statusz()["mesh"]
+        assert set(m) >= {"spec", "shape", "num_parts", "pool_entries",
+                          "plans", "engobs"}
+        assert m["num_parts"] == 4
+        assert m["engobs"]["pull_sharded"]["useful_ratio"] == 0.7
+        json.dumps(m)               # must stay JSON-serializable
+    monkeypatch.delenv("LUX_SERVE_MESH")
+    with Session(g, cfg(), warm=False) as s:
+        m = s.statusz()["mesh"]
+        assert m["num_parts"] == 1
+        assert isinstance(m["engobs"], dict)   # schema stable off-mesh
+        json.dumps(m)

@@ -4,6 +4,7 @@
 
 Usage:
   python tools/trace_summary.py TRACE.jsonl [-n 10]
+  python tools/trace_summary.py TRACE.jsonl --phases   # p50/p95 + phase split
   python tools/trace_summary.py TRACE.jsonl --to-chrome out.json
   python tools/trace_summary.py METRICS.jsonl          # run summary mode
 
@@ -43,9 +44,13 @@ def is_metrics_dump(events) -> bool:
 
 def spans_from_events(events):
     """Resolve B/E pairs (and X events) into (name, cat, dur_us, self_us)
-    via a per-(pid, tid) stack over time-ordered events."""
+    via a per-(pid, tid) stack over time-ordered events. Async "b"/"e"
+    pairs are matched by (name, cat, id) instead — they hop threads, so
+    the thread stacks never see them and their self time is the full
+    duration."""
     spans = []
     stacks = defaultdict(list)  # (pid, tid) -> [[name, cat, t0, child_us]]
+    open_async = {}             # (name, cat, id) -> t0
     for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
         ph = ev.get("ph")
         key = (ev.get("pid"), ev.get("tid"))
@@ -66,11 +71,87 @@ def spans_from_events(events):
         elif ph == "X":
             dur = ev.get("dur", 0.0)
             spans.append((ev.get("name"), ev.get("cat"), dur, dur))
+        elif ph == "b":
+            akey = (ev.get("name"), ev.get("cat"), ev.get("id"))
+            open_async.setdefault(akey, ev.get("ts", 0.0))
+        elif ph == "e":
+            akey = (ev.get("name"), ev.get("cat"), ev.get("id"))
+            t0 = open_async.pop(akey, None)
+            if t0 is None:
+                print(f"warning: async e without b for {ev.get('name')!r} "
+                      f"id={ev.get('id')!r}", file=sys.stderr)
+                continue
+            dur = ev.get("ts", 0.0) - t0
+            spans.append((ev.get("name"), ev.get("cat"), dur, dur))
     for key, stack in stacks.items():
         for name, *_ in stack:
             print(f"warning: unclosed span {name!r} on {key}",
                   file=sys.stderr)
+    for (name, _cat, id_) in open_async:
+        print(f"warning: unclosed async span {name!r} id={id_!r}",
+              file=sys.stderr)
     return spans
+
+
+def counters_from_events(events):
+    """Chrome "C" events → name -> list of (ts, {series: value})."""
+    series = defaultdict(list)
+    for ev in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        if ev.get("ph") != "C":
+            continue
+        vals = ev.get("args") or {}
+        series[ev.get("name")].append((ev.get("ts", 0.0), vals))
+    return series
+
+
+def _pct(sorted_vals, q):
+    """Linear-interpolated percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def print_phases(spans, counters):
+    """Per-span latency distribution plus the exchange/compute split for
+    every engine that reported phase-fenced iterations."""
+    by_name = defaultdict(list)
+    for name, _cat, dur, _self in spans:
+        by_name[name].append(dur)
+    print(f"{'span':<28} {'count':>6} {'p50_ms':>9} {'p95_ms':>9} "
+          f"{'total_ms':>10}")
+    for name in sorted(by_name):
+        durs = sorted(by_name[name])
+        print(f"{name:<28} {len(durs):>6} {_pct(durs, 0.5)/1e3:>9.3f} "
+              f"{_pct(durs, 0.95)/1e3:>9.3f} {sum(durs)/1e3:>10.3f}")
+    # Engines with both <engine>.exchange and <engine>.compute spans get
+    # a phase-split line: what fraction of fenced time was the collective.
+    engines = sorted(
+        name[:-len(".exchange")] for name in by_name
+        if name.endswith(".exchange")
+        and name[:-len(".exchange")] + ".compute" in by_name)
+    if engines:
+        print()
+        print(f"{'engine':<28} {'exchange_ms':>12} {'compute_ms':>11} "
+              f"{'exchange_frac':>14}")
+        for eng in engines:
+            exch = sum(by_name[eng + ".exchange"])
+            comp = sum(by_name[eng + ".compute"])
+            frac = exch / (exch + comp) if exch + comp > 0 else 0.0
+            print(f"{eng:<28} {exch/1e3:>12.3f} {comp/1e3:>11.3f} "
+                  f"{frac:>14.3f}")
+    # Counter series (e.g. <engine>.phases, <engine>.frontier) summarize
+    # as last-sample values — the steady-state view.
+    if counters:
+        print()
+        print(f"{'counter':<28} {'samples':>8}  last")
+        for name in sorted(counters):
+            pts = counters[name]
+            last = ", ".join(f"{k}={v:.4g}" for k, v in pts[-1][1].items())
+            print(f"{name:<28} {len(pts):>8}  {last}")
 
 
 def print_top_spans(spans, top_n: int):
@@ -116,6 +197,9 @@ def main(argv=None):
     ap.add_argument("--to-chrome", metavar="OUT",
                     help="write {'traceEvents': [...]} envelope to OUT for "
                     "Perfetto / chrome://tracing")
+    ap.add_argument("--phases", action="store_true",
+                    help="per-span p50/p95 table plus the exchange/compute "
+                    "phase split and counter series (engine observatory)")
     args = ap.parse_args(argv)
 
     events = read_jsonl(args.path)
@@ -123,10 +207,14 @@ def main(argv=None):
         raise SystemExit(f"{args.path}: empty file")
 
     if is_metrics_dump(events):
-        if args.to_chrome:
-            raise SystemExit("--to-chrome needs a trace file, not a "
-                             "metrics dump")
+        if args.to_chrome or args.phases:
+            raise SystemExit("--to-chrome/--phases need a trace file, not "
+                             "a metrics dump")
         print_metrics_summary(events, args.top)
+        return 0
+
+    if args.phases:
+        print_phases(spans_from_events(events), counters_from_events(events))
         return 0
 
     if args.to_chrome:

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -27,7 +27,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -63,6 +63,12 @@ snapshot-smoke:
 # the whole engine mesh under load, zero recompiles, /statusz mesh view.
 serve-sharded-smoke:
 	python tools/serve_sharded_smoke.py
+
+# GAS subsystem acceptance: every registry app served over HTTP with
+# host-oracle agreement, >= 1 adaptive mid-run direction switch on the
+# single-lane BFS, zero recompiles, /statusz direction-split block.
+gas-smoke:
+	python tools/gas_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

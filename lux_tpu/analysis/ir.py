@@ -481,6 +481,12 @@ def build_executor(kind: str, graph, program):
     if kind == "push_multi_sharded":
         from lux_tpu.engine.push import ShardedMultiSourcePushExecutor
         return ShardedMultiSourcePushExecutor(graph, program, k=4)
+    if kind == "gas":
+        from lux_tpu.engine.gas import AdaptiveExecutor, as_gas
+        return AdaptiveExecutor(graph, as_gas(program))
+    if kind == "gas_multi":
+        from lux_tpu.engine.gas import MultiSourceGasExecutor
+        return MultiSourceGasExecutor(graph, program, k=4)
     raise ValueError(f"unknown executor kind {kind!r}")
 
 

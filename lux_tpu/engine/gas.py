@@ -1,0 +1,740 @@
+"""Gather-apply-scatter (GAS) subsystem: one program abstraction, an
+adaptive direction-optimizing executor.
+
+Lux's headline engine capability is per-iteration push<->pull direction
+switching over an active frontier (frontier > nv/16 => pull,
+sssp_gpu.cu:414); until now this repo kept separate pull and push
+engines and never switched mid-run (ROADMAP item 3). Gunrock
+(PAPERS.md, arXiv:1501.05387) shows that a small operator set plus
+direction optimization yields a whole family of graph programs on one
+engine — this module is that layer:
+
+- :class:`GasProgram` declares the three pieces **once**:
+
+      msg_e   = gather(val[src_e], w_e)        # per edge, per direction
+      acc_v   = combine(msg_e for e into v)    # min | max | sum
+      new_v   = apply(old_v, acc_v)            # per vertex
+      front'_v = scatter(old_v, new_v)         # activation for the next
+                                               # iteration
+
+  The same ``gather`` runs in both directions, which is what makes
+  switching safe: pull masks non-frontier messages to the combiner
+  identity and segment-reduces over all CSC in-edges; push expands only
+  the frontier's CSR out-edges into an identity-filled accumulator.
+  Both materialize the *same* dense ``acc`` — elementwise min/max and
+  integer sums are exactly associative/commutative, so ``apply`` sees
+  bit-identical inputs whichever branch ran and results are **bitwise
+  equal** across pull, push, and adaptive schedules. (A float32 *sum*
+  combiner would reassociate; no frontier program uses one.)
+
+- :class:`AdaptiveExecutor` picks the direction per iteration from
+  frontier density with hysteresis (``LUX_GAS_DENSITY_HI`` /
+  ``LUX_GAS_DENSITY_LO``; ``LUX_GAS=pull|push|adaptive`` pins it). The
+  decision and both branches live inside one ``lax.cond`` under the
+  chunked ``lax.while_loop`` dispatch, so a mid-run switch costs zero
+  recompiles and zero extra host round-trips — the frontier count the
+  decision needs is the same scalar the halt check already computes.
+
+The legacy program models plug in through adapters (see
+engine/program.py ``as_gas``): a :class:`~lux_tpu.engine.push.PushProgram`
+maps ``relax`` onto ``gather`` and keeps its min/max merge; a
+:class:`~lux_tpu.engine.program.PullProgram` runs as a frontier-less
+fixed-iteration dense pull (``frontier = False`` below).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.program import EdgeCtx, PullProgram, VertexCtx
+from lux_tpu.engine.pull import hard_sync
+from lux_tpu.engine.push import (
+    PushProgram,
+    _chunk_while,
+    _queue_edge_slots,
+    _sparse_budgets,
+)
+from lux_tpu.graph.graph import Graph
+from lux_tpu.obs import (
+    NULL_RECORDER,
+    consume_compile_seconds,
+    engobs,
+    note_compile_seconds,
+    recorder_for,
+)
+from lux_tpu.ops.segment import identity_for, segment_reduce
+from lux_tpu.utils import flags
+from lux_tpu.utils.timing import Timer
+
+GAS_MODES = ("pull", "push", "adaptive")
+
+
+class GasProgram:
+    """One vertex program, two executable directions.
+
+    Frontier programs (``frontier = True``, the default) implement
+    ``init_values`` / ``init_frontier`` / ``gather`` and inherit the
+    combiner-merge ``apply`` and changed-bitmap ``scatter``; programs
+    with non-merge update rules (k-core's decrement) override those.
+    ``finalize_host`` derives extra host-side outputs (BFS parents,
+    label-prop community ids) from the converged values in numpy — it
+    runs after the device fixpoint, so it can never add a compile to a
+    served query.
+
+    Frontier-less programs (``frontier = False``; the PullProgram
+    adapter) implement ``edge_contrib`` / ``apply_ctx`` instead and run
+    a fixed number of dense pull iterations — direction optimization
+    needs an activation signal, which they don't have.
+    """
+
+    name: str = "gas"
+    combiner: str = "min"           # 'min' | 'max' | 'sum'
+    value_dtype = jnp.uint32
+    needs_weights: bool = False
+    rooted: bool = False            # takes a per-query `start` root
+    servable: bool = True           # exposed through serve/session.py
+    frontier: bool = True           # False => fixed-iteration dense pull
+
+    # -- frontier-program hooks ------------------------------------------
+
+    def init_values(self, graph: Graph, **kw) -> np.ndarray:
+        raise NotImplementedError
+
+    def init_frontier(self, graph: Graph, **kw) -> np.ndarray:
+        raise NotImplementedError
+
+    def gather(self, src_vals: jnp.ndarray, weights) -> jnp.ndarray:
+        """Per-edge message from an active source — the ONE edge
+        function both directions run."""
+        raise NotImplementedError
+
+    def apply(self, old: jnp.ndarray, acc: jnp.ndarray) -> jnp.ndarray:
+        """Combine the accumulated messages into the new value; the
+        default is the combiner's monotone merge."""
+        if self.combiner == "min":
+            return jnp.minimum(old, acc)
+        if self.combiner == "max":
+            return jnp.maximum(old, acc)
+        raise NotImplementedError(
+            f"{self.name}: sum-combiner programs must override apply()"
+        )
+
+    def scatter(self, old: jnp.ndarray, new: jnp.ndarray) -> jnp.ndarray:
+        """Next iteration's frontier (the adaptive changed bitmap)."""
+        return new != old
+
+    def finalize_host(self, graph: Graph, values: np.ndarray) -> dict:
+        """Extra host-side outputs derived from converged values."""
+        return {}
+
+    def edge_invariant(self, src_vals, dst_vals, weights):
+        """Per-edge fixpoint invariant for `-check` (engine/check.py)."""
+        raise NotImplementedError
+
+    # -- frontier-less hooks (PullProgram adapter) -----------------------
+
+    def edge_contrib(self, edge: EdgeCtx) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def apply_ctx(self, old, acc, ctx: VertexCtx):
+        raise NotImplementedError
+
+
+class GasState(NamedTuple):
+    values: jnp.ndarray     # (nv,) or (nv, K)
+    frontier: jnp.ndarray   # bool, same leading shape
+    direction: jnp.ndarray  # int32 scalar: direction the PREVIOUS
+    #                         iteration took (0 pull, 1 push) — the
+    #                         hysteresis memory, carried on-device
+
+
+# -- adapters -------------------------------------------------------------
+
+
+class PushGasAdapter(GasProgram):
+    """A PushProgram as a GasProgram: ``relax`` becomes ``gather``, the
+    min/max merge and changed-bitmap activation are the defaults — the
+    per-iteration math is bit-identical to PushExecutor's dense branch."""
+
+    def __init__(self, inner: PushProgram):
+        self.inner = inner
+        self.name = inner.name
+        self.combiner = inner.combiner
+        self.value_dtype = inner.value_dtype
+        self.needs_weights = inner.needs_weights
+        self.rooted = getattr(inner, "rooted", False)
+
+    def init_values(self, graph: Graph, **kw) -> np.ndarray:
+        return self.inner.init_values(graph, **kw)
+
+    def init_frontier(self, graph: Graph, **kw) -> np.ndarray:
+        return self.inner.init_frontier(graph, **kw)
+
+    def gather(self, src_vals, weights):
+        return self.inner.relax(src_vals, weights)
+
+
+class PullGasAdapter(GasProgram):
+    """A PullProgram as a frontier-less GasProgram: dense pull only,
+    fixed iteration count, ``edge_contrib``/``apply`` forwarded (with
+    the VertexCtx the pull model's update rule needs)."""
+
+    frontier = False
+    servable = False     # pagerank/colfilter keep their pull serving path
+
+    def __init__(self, inner: PullProgram):
+        self.inner = inner
+        self.name = inner.name
+        self.combiner = inner.combiner
+        self.value_dtype = inner.value_dtype
+        self.needs_weights = inner.needs_weights
+
+    def init_values(self, graph: Graph, **kw) -> np.ndarray:
+        return self.inner.init_values(graph)
+
+    def init_frontier(self, graph: Graph, **kw) -> np.ndarray:
+        return np.ones(graph.nv, dtype=bool)
+
+    def edge_contrib(self, edge: EdgeCtx) -> jnp.ndarray:
+        return self.inner.edge_contrib(edge)
+
+    def apply_ctx(self, old, acc, ctx: VertexCtx):
+        return self.inner.apply(old, acc, ctx)
+
+
+def as_gas(program) -> GasProgram:
+    """Normalize any registered program model to a GasProgram."""
+    if isinstance(program, GasProgram):
+        return program
+    if isinstance(program, PushProgram):
+        return PushGasAdapter(program)
+    if isinstance(program, PullProgram):
+        return PullGasAdapter(program)
+    raise TypeError(
+        f"cannot adapt {type(program).__name__} to a GasProgram"
+    )
+
+
+# -- the adaptive executor ------------------------------------------------
+
+
+def _resolve_mode(mode: Optional[str]) -> str:
+    mode = mode if mode is not None else flags.get("LUX_GAS")
+    if mode not in GAS_MODES:
+        raise ValueError(
+            f"LUX_GAS={mode!r}: use one of {'|'.join(GAS_MODES)}"
+        )
+    return mode
+
+
+class AdaptiveExecutor:
+    """Single-device GAS executor with per-iteration direction choice.
+
+    Per iteration, from the frontier about to be expanded:
+
+    - **pull**: messages from all CSC in-edges, non-frontier sources
+      masked to the combiner identity, one segment reduce — O(ne) but
+      fully dense/vectorized. Right when the frontier is a large
+      fraction of the graph.
+    - **push**: the frontier compacts into a bounded queue whose CSR
+      out-edges scatter-combine into an identity-filled accumulator —
+      work scales with frontier out-edges, not ne. Right for small
+      frontiers (BFS start/tail, near-fixpoint label propagation).
+
+    Adaptive hysteresis (density = frontier / nv): density >=
+    ``LUX_GAS_DENSITY_HI`` forces pull, density <= ``LUX_GAS_DENSITY_LO``
+    forces push, in between the previous direction sticks. A push the
+    static queue/edge budgets cannot hold falls back to pull (the
+    reference's sparse->dense overflow fallback) — recorded directions
+    are always the branch actually taken. Either branch produces the
+    identical dense ``acc``, so results are bitwise-equal across modes.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        program: GasProgram,
+        device=None,
+        mode: Optional[str] = None,
+        queue_frac: int = 16,
+        edge_budget_frac: int = 8,
+    ):
+        if program.needs_weights and graph.weights is None:
+            raise ValueError(f"{program.name} requires an edge-weighted graph")
+        self.graph = graph
+        self.program = program
+        self.device = device
+        self.mode = "pull" if not program.frontier else _resolve_mode(mode)
+        put = lambda x: jax.device_put(jnp.asarray(x), device)
+
+        nv = int(graph.nv)
+        hi = flags.get_float("LUX_GAS_DENSITY_HI")
+        lo = flags.get_float("LUX_GAS_DENSITY_LO")
+        if not 0.0 < lo <= hi <= 1.0:
+            raise ValueError(
+                f"need 0 < LUX_GAS_DENSITY_LO <= LUX_GAS_DENSITY_HI <= 1 "
+                f"(got lo={lo}, hi={hi})"
+            )
+        self.hi_count = max(1, math.ceil(hi * nv))
+        self.lo_count = max(0, math.ceil(lo * nv))
+
+        dg = {
+            "col_src": put(graph.col_src.astype(np.int32)),
+            "seg_ids": put(graph.col_dst),
+        }
+        if graph.weights is not None:
+            dg["weights"] = put(graph.weights)
+        if not program.frontier:
+            # The VertexCtx the pull model's apply consumes.
+            dg["out_degrees"] = put(graph.out_degrees.astype(np.int32))
+            dg["in_degrees"] = put(graph.in_degrees.astype(np.int32))
+        elif self.mode != "pull":
+            # Push direction: CSR expansion arrays + budgets sized so
+            # every frontier the policy can route here fits (the stay-
+            # push hysteresis band tops out at hi_count).
+            from lux_tpu.engine.pull import _edge_index_dtype
+
+            q_cap, e_budget = _sparse_budgets(
+                nv, int(graph.ne), queue_frac, edge_budget_frac
+            )
+            self.queue_cap = max(q_cap, self.hi_count + 128)
+            self.edge_budget = e_budget
+            csr = graph.csr()
+            eidx = _edge_index_dtype(graph.ne)
+            dg["csr_row_ptr"] = put(csr.row_ptr.astype(eidx))
+            dg["csr_col_dst"] = put(csr.col_dst)
+            if csr.weights is not None:
+                dg["csr_weights"] = put(csr.weights)
+            dg["out_degrees"] = put(graph.out_degrees.astype(np.int32))
+        self._dg = dg
+        # Filled by run(): the per-run direction ledger.
+        self.push_iters = 0
+        self.pull_iters = 0
+        self.direction_switches = 0
+        self._step = jax.jit(self._step_impl, donate_argnums=0)
+        self._multi_jit = jax.jit(
+            self._chunk_impl, donate_argnums=0, static_argnums=2
+        )
+
+    # -- the two directions ----------------------------------------------
+
+    def _pull_acc(self, state: GasState, dg):
+        """Dense accumulator over all CSC in-edges (non-frontier
+        messages masked to the combiner identity)."""
+        prog = self.program
+        src_vals = state.values[dg["col_src"]]
+        src_front = state.frontier[dg["col_src"]]
+        msg = prog.gather(src_vals, dg.get("weights"))
+        ident = identity_for(prog.combiner, msg.dtype)
+        msg = jnp.where(src_front, msg, ident)
+        return segment_reduce(
+            msg, dg["seg_ids"], num_segments=self.graph.nv,
+            kind=prog.combiner,
+        )
+
+    def _push_acc(self, state: GasState, dg):
+        """The same dense accumulator built the sparse way: frontier ->
+        bounded queue -> static CSR edge-slot expansion -> scatter into
+        an identity-filled (nv,) array. Equality with _pull_acc is
+        exact: both reduce the same per-vertex message multiset with an
+        exactly-associative combiner."""
+        prog = self.program
+        nv = self.graph.nv
+        rp = dg["csr_row_ptr"]
+        q = jnp.nonzero(
+            state.frontier, size=self.queue_cap, fill_value=nv
+        )[0].astype(jnp.int32)
+        start = rp[q]
+        deg = rp[jnp.minimum(q + 1, nv)] - start
+        slot, edge_pos, emask = _queue_edge_slots(
+            start, deg, self.edge_budget, max(self.graph.ne, 1)
+        )
+        dst = dg["csr_col_dst"][edge_pos]
+        src_vals = state.values[jnp.clip(q[slot], 0, nv - 1)]
+        w = dg["csr_weights"][edge_pos] if "csr_weights" in dg else None
+        msg = prog.gather(src_vals, w)
+        ident = identity_for(prog.combiner, msg.dtype)
+        msg = jnp.where(emask, msg, ident)
+        dst = jnp.where(emask, dst, 0)
+        acc = jnp.full((nv,), ident, dtype=msg.dtype)
+        if prog.combiner == "min":
+            return acc.at[dst].min(msg)
+        if prog.combiner == "max":
+            return acc.at[dst].max(msg)
+        return acc.at[dst].add(msg)
+
+    def _decide_push(self, state: GasState, dg, cnt):
+        """Traced direction decision for the frontier about to expand:
+        pinned modes are Python constants (only their branch traces);
+        adaptive is the density hysteresis, and any push must also fit
+        the static queue/edge budgets."""
+        if self.mode == "pull":
+            return None          # caller skips the cond entirely
+        if self.mode == "push":
+            want = jnp.bool_(True)
+        else:
+            prev_push = state.direction > 0
+            want = jnp.where(
+                cnt >= jnp.int32(self.hi_count), False,
+                jnp.where(cnt <= jnp.int32(self.lo_count), True, prev_push),
+            )
+        out_edges = jnp.where(
+            state.frontier, dg["out_degrees"].astype(jnp.uint32), 0
+        ).sum(dtype=jnp.uint32)
+        fits = (cnt <= jnp.int32(self.queue_cap)) & (
+            out_edges <= jnp.uint32(self.edge_budget)
+        )
+        return want & fits
+
+    def _frontier_iter(self, state: GasState, dg):
+        prog = self.program
+        cnt = state.frontier.sum(dtype=jnp.int32)
+        take_push = self._decide_push(state, dg, cnt)
+        if take_push is None:
+            acc = self._pull_acc(state, dg)
+            direction = jnp.int32(0)
+        else:
+            acc = jax.lax.cond(
+                take_push,
+                lambda st: self._push_acc(st, dg),
+                lambda st: self._pull_acc(st, dg),
+                state,
+            )
+            direction = take_push.astype(jnp.int32)
+        new = prog.apply(state.values, acc)
+        frontier = prog.scatter(state.values, new)
+        ncnt = frontier.sum(dtype=jnp.int32)
+        return GasState(new, frontier, direction), ncnt, direction
+
+    def _dense_pull_iter(self, state: GasState, dg):
+        """Frontier-less (PullProgram-adapted) iteration: plain dense
+        pull with the vertex context; the count stays nv so the chunked
+        loop never halts early — run() bounds it with max_iters."""
+        prog = self.program
+        edge = EdgeCtx(
+            src_vals=state.values[dg["col_src"]],
+            dst_vals=state.values[dg["seg_ids"]],
+            weights=dg.get("weights"),
+        )
+        acc = segment_reduce(
+            prog.edge_contrib(edge), dg["seg_ids"],
+            num_segments=self.graph.nv, kind=prog.combiner,
+        )
+        ctx = VertexCtx(
+            nv=self.graph.nv,
+            out_degrees=dg["out_degrees"],
+            in_degrees=dg["in_degrees"],
+        )
+        new = prog.apply_ctx(state.values, acc, ctx)
+        # Pass frontier and direction through unchanged (not fresh
+        # constants) so the donated buffers alias outputs (LUX104).
+        return (
+            GasState(new, state.frontier, state.direction),
+            jnp.int32(self.graph.nv),
+            jnp.int32(0),
+        )
+
+    def _one_iter(self, state: GasState, dg):
+        if self.program.frontier:
+            return self._frontier_iter(state, dg)
+        return self._dense_pull_iter(state, dg)
+
+    def _step_impl(self, state: GasState, dg):
+        st, cnt, _ = self._one_iter(state, dg)
+        return st, cnt
+
+    def _chunk_impl(self, state: GasState, dg, k: int, limit=None):
+        return _chunk_while(
+            lambda st: self._one_iter(st, dg), state, k, limit
+        )
+
+    # -- driving ----------------------------------------------------------
+
+    def init_state(self, **kw) -> GasState:
+        vals = jax.device_put(
+            jnp.asarray(self.program.init_values(self.graph, **kw)),
+            self.device,
+        )
+        fr = jax.device_put(
+            jnp.asarray(self.program.init_frontier(self.graph, **kw)),
+            self.device,
+        )
+        return GasState(vals, fr, jnp.int32(0))
+
+    def step(self, state: GasState):
+        return self._step(state, self._dg)
+
+    def _multi(self, state: GasState, limit: int, k: int):
+        return self._multi_jit(state, self._dg, k, limit=jnp.int32(limit))
+
+    def run(
+        self,
+        max_iters: Optional[int] = None,
+        state: Optional[GasState] = None,
+        chunk: int = 16,
+        recorder=None,
+        **init_kw,
+    ):
+        """Iterate to fixpoint (or ``max_iters``); returns
+        (final_state, iterations_run). The per-iteration directions land
+        in ``self.push_iters`` / ``self.pull_iters`` /
+        ``self.direction_switches`` and in the iteration records'
+        ``branch`` fields."""
+        if not self.program.frontier and max_iters is None:
+            raise ValueError(
+                f"{self.program.name} is a frontier-less pull program; "
+                "run() needs max_iters"
+            )
+        if state is None:
+            state = self.init_state(**init_kw)
+        rec = recorder if recorder is not None else recorder_for(
+            "gas", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne))
+        state, total, pushes, switches = _run_gas_fixpoint(
+            self._multi, state, max_iters, chunk, recorder=rec
+        )
+        self.push_iters = pushes
+        self.pull_iters = total - pushes
+        self.direction_switches = switches
+        engobs.note(
+            "gas", program=self.program.name, mode=self.mode,
+            num_iters=total, direction_push=pushes,
+            direction_pull=total - pushes, direction_switches=switches,
+        )
+        rec.finish()
+        return state, total
+
+    def warmup(self, chunk: int = 16, **init_kw):
+        """Compile the chunked executable (both direction branches live
+        under its one lax.cond) outside any timed/served request."""
+        with Timer() as t:
+            _run_gas_fixpoint(
+                self._multi, self.init_state(**init_kw),
+                1 if self.program.frontier else 1, chunk,
+            )
+        note_compile_seconds(self, t.elapsed)
+
+    def finalize(self, state: GasState) -> dict:
+        """Host-side derived outputs for the converged state (numpy —
+        never compiles)."""
+        vals = np.asarray(jax.device_get(state.values))
+        return self.program.finalize_host(self.graph, vals)
+
+    def trace_step(self, **init_kw):
+        """luxlint-IR hook (analysis/ir.py): the jitted single-iteration
+        step with example args exactly as step() passes them."""
+        return {
+            "kind": "gas",
+            "fn": self._step,
+            "args": (self.init_state(**init_kw), self._dg),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }
+
+
+def _run_gas_fixpoint(multi, state, max_iters, chunk, recorder=None):
+    """Chunked host loop (the push fixpoint's design): one batched
+    device_get per chunk; the flag lane of the chunk carries the
+    per-iteration direction taken. Returns (state, total_iters,
+    push_iters, direction_switches)."""
+    rec = recorder if recorder is not None else NULL_RECORDER
+    total = 0
+    push_total = 0
+    switches = 0
+    prev = None
+    while True:
+        limit = chunk if max_iters is None else min(chunk, max_iters - total)
+        if limit <= 0:
+            break
+        k = chunk
+        state, counts, dirs, done, last = multi(state, limit, k)
+        # luxlint: disable=LUX001 -- one batched fetch per chunk (not per iter) is the fixpoint design
+        counts_h, dirs_h, done_h, last_h = jax.device_get(
+            (counts, dirs, done, last)
+        )
+        done_i = int(np.asarray(done_h).reshape(-1)[0])
+        last_i = int(np.asarray(last_h).reshape(-1)[0])
+        dl = np.asarray(dirs_h).reshape(-1, k)[0][:done_i]
+        if dl.size:
+            # Host-side direction bookkeeping on the already-fetched
+            # window: switches = sign changes across the chunk boundary
+            # and within it.
+            seq = dl if prev is None else np.concatenate(([prev], dl))
+            switches += int(np.count_nonzero(np.diff(seq.astype(np.int64))))
+            prev = dl[-1]
+        push_total += int(dl.sum())
+        total += done_i
+        cnts = np.asarray(counts_h).reshape(-1, k)[0][:done_i]
+        rec.flush(total, frontier_sizes=cnts, directions=dl)
+        if last_i == 0 or done_i == 0:
+            break
+    hard_sync(state.values)
+    rec.flush(total)
+    return state, total, push_total, switches
+
+
+class MultiSourceGasExecutor:
+    """Dense GAS executor over K value columns: one O(ne) pull-direction
+    sweep serves K independent root queries of any rooted GasProgram
+    (the serving batcher's mechanism, generalized from
+    MultiSourcePushExecutor).
+
+    Push-direction queue compaction is single-lane-shaped, so this
+    executor is pull-only; per-lane results are still bitwise-identical
+    to a single-source :class:`AdaptiveExecutor` run because every
+    direction builds the same dense accumulator."""
+
+    def __init__(self, graph: Graph, program: GasProgram, k: int,
+                 device=None):
+        if k < 1:
+            raise ValueError(f"batch width k must be >= 1 (got {k})")
+        program = as_gas(program)
+        if not program.frontier:
+            raise ValueError(
+                f"{program.name} is frontier-less; multi-source batching "
+                "needs a rooted frontier program"
+            )
+        if program.needs_weights and graph.weights is None:
+            raise ValueError(f"{program.name} requires an edge-weighted graph")
+        self.graph = graph
+        self.program = program
+        self.k = int(k)
+        self.device = device
+        put = lambda x: jax.device_put(jnp.asarray(x), device)
+        dg = {
+            "col_src": put(graph.col_src.astype(np.int32)),
+            "seg_ids": put(graph.col_dst),
+        }
+        if graph.weights is not None:
+            dg["weights"] = put(graph.weights)
+        self._dg = dg
+        self.push_iters = 0          # API parity (pull-only: always 0)
+        self.pull_iters = 0
+        self.direction_switches = 0
+        self._multi_jit = jax.jit(
+            self._chunk_impl, donate_argnums=0, static_argnums=2
+        )
+
+    def init_state(self, starts) -> GasState:
+        """One value/frontier column per root; fewer than k roots are
+        right-padded by repeating the last root (duplicate lanes
+        converge identically, so padding changes nothing)."""
+        starts = list(starts)
+        if not 1 <= len(starts) <= self.k:
+            raise ValueError(f"need 1..{self.k} roots, got {len(starts)}")
+        starts = starts + [starts[-1]] * (self.k - len(starts))
+        prog = self.program
+        vals = np.stack(
+            [prog.init_values(self.graph, start=s) for s in starts], axis=1
+        )
+        fr = np.stack(
+            [prog.init_frontier(self.graph, start=s) for s in starts], axis=1
+        )
+        return GasState(
+            jax.device_put(jnp.asarray(vals), self.device),
+            jax.device_put(jnp.asarray(fr), self.device),
+            jnp.int32(0),
+        )
+
+    def _one_iter(self, state: GasState, dg):
+        prog = self.program
+        src_vals = state.values[dg["col_src"]]        # (ne, K)
+        src_front = state.frontier[dg["col_src"]]
+        w = dg.get("weights")
+        msg = prog.gather(src_vals, None if w is None else w[:, None])
+        ident = identity_for(prog.combiner, msg.dtype)
+        msg = jnp.where(src_front, msg, ident)
+        acc = segment_reduce(
+            msg, dg["seg_ids"], num_segments=self.graph.nv,
+            kind=prog.combiner,
+        )
+        new = prog.apply(state.values, acc)
+        frontier = prog.scatter(state.values, new)
+        return (
+            GasState(new, frontier, jnp.int32(0)),
+            frontier.sum(dtype=jnp.int32),
+            jnp.int32(0),
+        )
+
+    def _chunk_impl(self, state: GasState, dg, k: int, limit=None):
+        return _chunk_while(
+            lambda st: self._one_iter(st, dg), state, k, limit
+        )
+
+    def _multi(self, state: GasState, limit: int, k: int):
+        return self._multi_jit(state, self._dg, k, limit=jnp.int32(limit))
+
+    def run(
+        self,
+        starts,
+        max_iters: Optional[int] = None,
+        chunk: int = 16,
+        recorder=None,
+        state: Optional[GasState] = None,
+    ):
+        """Run all roots to the shared fixpoint; column j of
+        ``state.values`` is root ``starts[j]``'s result."""
+        if state is None:
+            state = self.init_state(starts)
+        rec = recorder if recorder is not None else recorder_for(
+            "gas_multi", self.graph, self.program)
+        rec.start()
+        if rec.enabled:
+            rec.record_compile(consume_compile_seconds(self))
+            rec.set_hbm_bytes(engobs.hbm_bytes_per_iter(
+                self.graph.nv, self.graph.ne, k=self.k))
+        state, total, _, _ = _run_gas_fixpoint(
+            self._multi, state, max_iters, chunk, recorder=rec
+        )
+        self.pull_iters = total
+        engobs.note(
+            "gas_multi", program=self.program.name, mode="pull",
+            num_iters=total, lanes=self.k,
+        )
+        rec.finish()
+        return state, total
+
+    def warmup(self, chunk: int = 16, start: int = 0):
+        with Timer() as t:
+            _run_gas_fixpoint(
+                self._multi, self.init_state([start]), 1, chunk
+            )
+        note_compile_seconds(self, t.elapsed)
+
+    def values_for(self, state: GasState, j: int) -> np.ndarray:
+        """Host copy of lane ``j``'s value column."""
+        return np.asarray(jax.device_get(state.values[:, j]))
+
+    def finalize_for(self, state: GasState, j: int) -> dict:
+        return self.program.finalize_host(
+            self.graph, self.values_for(state, j)
+        )
+
+    def trace_step(self, start: int = 0, **init_kw):
+        """luxlint-IR hook; the chunk executable takes a static width k
+        and a dynamic limit the example args can't carry, so
+        `call`/`lower` close over them (MultiSourcePushExecutor's
+        pattern)."""
+        state = self.init_state([start])
+        fn, dg, k = self._multi_jit, self._dg, self.k
+        lim = jnp.int32(1)
+        return {
+            "kind": "gas_multi",
+            "fn": fn,
+            "args": (state, dg),
+            "call": lambda st, d: fn(st, d, k, limit=lim),
+            "lower": lambda: fn.lower(state, dg, k, limit=lim),
+            "donate": (0,),
+            "carry": (0,),
+            "sharded": False,
+        }

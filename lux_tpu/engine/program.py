@@ -55,6 +55,8 @@ class PullProgram:
     value_dtype = jnp.float32
     value_shape: Tuple[int, ...] = ()  # trailing per-vertex dims, e.g. (K,)
     needs_weights: bool = False
+    rooted: bool = False              # takes a per-query `start` root
+    servable: bool = True             # exposed through serve/session.py
     # True iff edge_contrib(e) == e.src_vals (an SpMV-shaped iteration);
     # unlocks the MXU tiled-hybrid executor (engine/tiled.py).
     identity_contrib: bool = False
@@ -72,3 +74,14 @@ class PullProgram:
     def apply(self, old_vals: jnp.ndarray, acc: jnp.ndarray, ctx: VertexCtx):
         """Combine accumulator with the old value into the new value."""
         raise NotImplementedError
+
+
+def as_gas(program):
+    """Adapt any registered program model (PullProgram, PushProgram, or a
+    native GasProgram) to the gather-apply-scatter abstraction the
+    adaptive executor runs (engine/gas.py). The adapters subclass
+    GasProgram, so they live there; this is the import-cycle-free entry
+    point the registry/serving layers use."""
+    from lux_tpu.engine import gas
+
+    return gas.as_gas(program)

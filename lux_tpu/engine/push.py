@@ -67,6 +67,8 @@ class PushProgram:
     combiner: str = "min"          # 'min' | 'max'
     value_dtype = jnp.uint32
     needs_weights: bool = False
+    rooted: bool = False           # takes a per-query `start` root
+    servable: bool = True          # exposed through serve/session.py
     # Declare True iff every value the program can ever hold fits in 31
     # bits (e.g. SSSP distances and CC labels, both <= nv < 2^31). The
     # blocked dense path packs the frontier bit into the value's top bit

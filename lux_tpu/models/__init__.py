@@ -1,10 +1,16 @@
 """The applications (the reference's pagerank/, sssp/, components/,
-col_filter/ directories, re-expressed as vertex programs)."""
+col_filter/ directories re-expressed as vertex programs, plus the GAS
+registry widening: BFS, weighted delta-SSSP, label propagation, k-core).
+"""
 
 from lux_tpu.models.pagerank import PageRank
 from lux_tpu.models.sssp import SSSP
 from lux_tpu.models.components import ConnectedComponents
 from lux_tpu.models.colfilter import CollaborativeFiltering
+from lux_tpu.models.bfs import BFS
+from lux_tpu.models.sssp_delta import DeltaSSSP
+from lux_tpu.models.labelprop import LabelPropagation
+from lux_tpu.models.kcore import KCore
 
 # App registry: the one name → program mapping shared by the serving
 # layer (serve/session.py routes queries by these names) and tools.
@@ -16,20 +22,36 @@ PROGRAMS = {
     "sssp": SSSP,
     "components": ConnectedComponents,
     "colfilter": CollaborativeFiltering,
+    "bfs": BFS,
+    "sssp_delta": DeltaSSSP,
+    "labelprop": LabelPropagation,
+    "kcore": KCore,
 }
 
-ROOTED_APPS = frozenset({"sssp"})
+# Derived from each program's ``rooted`` declaration so a new rooted
+# program can't silently miss multi-source batching by not being added
+# to a hand-maintained set here.
+ROOTED_APPS = frozenset(
+    name for name, cls in PROGRAMS.items() if getattr(cls, "rooted", False)
+)
 
 # Which executor kinds can run each program (the luxlint-IR trace
 # matrix, analysis/ir.py — and the capability map cli/serve consult).
 # tiled is spmv-only (sum combiner, identity contrib, scalar values);
-# push needs a PushProgram; multi-source batching needs a rooted app.
+# push needs a PushProgram; multi-source batching needs a rooted app;
+# gas runs every program (legacy models through the engine/program.py
+# ``as_gas`` adapters — PullPrograms as frontier-less dense pull);
+# gas_multi needs a rooted frontier program.
 ENGINE_KINDS = {
-    "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded"),
+    "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded", "gas"),
     "sssp": ("push", "push_multi", "push_incremental", "push_sharded",
-             "push_multi_sharded"),
-    "components": ("push", "push_incremental", "push_sharded"),
-    "colfilter": ("pull", "pull_sharded"),
+             "push_multi_sharded", "gas", "gas_multi"),
+    "components": ("push", "push_incremental", "push_sharded", "gas"),
+    "colfilter": ("pull", "pull_sharded", "gas"),
+    "bfs": ("gas", "gas_multi"),
+    "sssp_delta": ("gas", "gas_multi"),
+    "labelprop": ("gas",),
+    "kcore": ("gas",),
 }
 
 
@@ -58,6 +80,10 @@ __all__ = [
     "SSSP",
     "ConnectedComponents",
     "CollaborativeFiltering",
+    "BFS",
+    "DeltaSSSP",
+    "LabelPropagation",
+    "KCore",
     "PROGRAMS",
     "ROOTED_APPS",
     "ENGINE_KINDS",

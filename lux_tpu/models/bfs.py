@@ -1,0 +1,87 @@
+"""Breadth-first search with parent derivation (GAS model).
+
+The canonical direction-optimizing workload (Beamer's BFS is the example
+every direction-switching engine leads with): the frontier starts as one
+vertex, explodes to a large fraction of the graph in the middle levels,
+and collapses again in the tail — exactly the shape the adaptive
+executor's density hysteresis exists for. Depths are the SSSP hop-count
+fixpoint (same monotone min-relaxation, ``sssp_gpu.cu:48-61``); the
+parent array is derived on the host *after* convergence with a
+deterministic tie-break (minimum-id predecessor on a shortest path), so
+it is reproducible across directions and engines — a device-side
+parent-claiming race would not be.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.gas import GasProgram
+from lux_tpu.graph.graph import Graph
+
+
+class BFS(GasProgram):
+    name = "bfs"
+    combiner = "min"
+    value_dtype = jnp.uint32
+    rooted = True
+
+    def init_values(self, graph: Graph, start: int = 0) -> np.ndarray:
+        depth = np.full(graph.nv, graph.nv, dtype=np.uint32)  # ∞ == nv
+        depth[start] = 0
+        return depth
+
+    def init_frontier(self, graph: Graph, start: int = 0) -> np.ndarray:
+        fr = np.zeros(graph.nv, dtype=bool)
+        fr[start] = True
+        return fr
+
+    def gather(self, src_vals, weights):
+        return src_vals + jnp.uint32(1)
+
+    def edge_invariant(self, src_vals, dst_vals, weights):
+        return dst_vals <= src_vals + jnp.uint32(1)
+
+    def finalize_host(self, graph: Graph, values: np.ndarray) -> dict:
+        return {"parent": bfs_parents(graph, values)}
+
+
+def bfs_parents(graph: Graph, depth: np.ndarray) -> np.ndarray:
+    """Minimum-id shortest-path predecessor per reached vertex, from the
+    converged depth array (the root parents itself; unreached vertices
+    get nv). One vectorized pass over the CSC edge list; int64 host
+    math, uint32 out."""
+    nv = graph.nv
+    d = depth.astype(np.int64)
+    src = graph.col_src.astype(np.int64)
+    dst = graph.col_dst.astype(np.int64)
+    # Edge (u -> v) is a tree-edge candidate iff depth[u] + 1 == depth[v].
+    cand = np.where(d[src] + 1 == d[dst], src, nv)
+    parent = np.full(nv, nv, dtype=np.int64)
+    np.minimum.at(parent, dst, cand)
+    parent[d == 0] = np.flatnonzero(d == 0)   # the root parents itself
+    parent[d >= nv] = nv                      # unreached
+    return parent.astype(np.uint32)
+
+
+def reference_bfs(graph: Graph, start: int = 0):
+    """Host oracle: (depth, parent) with the same deterministic
+    minimum-id tie-break."""
+    from lux_tpu.models.sssp import reference_sssp
+
+    depth = reference_sssp(graph, start)
+    return depth, bfs_parents(graph, depth)
+
+
+def main(argv=None):
+    """CLI: python -m lux_tpu.models.bfs -file g.lux -start R"""
+    from lux_tpu.models.cli import run_push_app
+
+    return run_push_app(BFS(), argv, supports_start=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

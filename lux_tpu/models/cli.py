@@ -178,6 +178,22 @@ def make_executor(g, program, args, log=None):
     forces the plain gather engine."""
     if log is None:
         log = get_logger(program.name)
+    from lux_tpu.engine.gas import AdaptiveExecutor, GasProgram
+
+    if isinstance(program, GasProgram):
+        # The adaptive executor owns its direction choice (LUX_GAS pins
+        # it); layout/parts knobs belong to the legacy engines.
+        if args.parts > 1:
+            raise SystemExit(
+                f"error: {program.name} (a GAS app) is single-device for "
+                "now; drop -parts"
+            )
+        if args.layout != "auto":
+            raise SystemExit(
+                f"error: -layout {args.layout} has no effect on "
+                f"{program.name} (a GAS app); use LUX_GAS=pull|push|adaptive"
+            )
+        return AdaptiveExecutor(g, program)
     is_push = hasattr(program, "init_frontier")
     use_tiled = False
     if is_push and args.layout != "auto":
@@ -489,11 +505,17 @@ def run_push_app(program, argv, supports_start: bool):
     ex.warmup(**init_kw)
 
     with _profiler(args.profile):
-        if args.verbose:
+        if args.verbose and hasattr(ex, "phase_step"):
             state, iters, t = _run_push_verbose(
                 ex, state, max_iters, start_iter, init_kw
             )
         else:
+            if args.verbose:
+                log.info(
+                    "per-phase -verbose breakdown is push-engine only; "
+                    "running the fused loop (direction split lands in "
+                    "telemetry/engobs)"
+                )
             with Timer() as t:
                 state, iters = ex.run(
                     max_iters=max_iters, state=state, **init_kw

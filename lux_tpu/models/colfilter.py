@@ -35,6 +35,7 @@ class CollaborativeFiltering(PullProgram):
     value_dtype = jnp.float32
     value_shape = (K,)
     needs_weights = True
+    servable = False   # training workload: CLI/bench only, not a query app
 
     def init_values(self, graph: Graph) -> np.ndarray:
         value = np.sqrt(1.0 / K).astype(np.float32)

@@ -1,0 +1,82 @@
+"""k-core decomposition by peeling (GAS model).
+
+Values are live in-degrees; the frontier is the set of vertices removed
+this round. A removed vertex fires exactly once, sending one unit-count
+message per out-edge; survivors decrement their degree by the received
+count and join the next frontier iff that drops them below k. The
+fixpoint's alive set is the k-core (the maximal subgraph where every
+vertex keeps in-degree >= k), and the monotone one-shot firing is why
+uint32 arithmetic is safe: cumulative decrements at a vertex never
+exceed its initial in-degree, so alive degrees never underflow. Removed
+vertices freeze at their at-removal degree (the where() in apply), which
+also makes results bitwise-identical across push/pull/adaptive — both
+directions deliver the same per-round counts.
+
+Frontier shape: large first wave on sparse graphs, then a dwindling
+cascade — another direction-switch workload, mirroring Gunrock's k-core
+filter-iterate formulation (PAPERS.md, arXiv:1701.01170).
+
+``k`` is a constructor parameter (a Python static), so each k compiles
+its own executable; the serving layer keys engines by k and warms the
+default (k=2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.gas import GasProgram
+from lux_tpu.graph.graph import Graph
+
+
+class KCore(GasProgram):
+    name = "kcore"
+    combiner = "sum"
+    value_dtype = jnp.uint32
+
+    def __init__(self, k: int = 2):
+        if int(k) < 1:
+            raise ValueError(f"kcore needs k >= 1 (got {k})")
+        self.k = int(k)
+
+    def init_values(self, graph: Graph, **kw) -> np.ndarray:
+        return graph.in_degrees.astype(np.uint32)
+
+    def init_frontier(self, graph: Graph, **kw) -> np.ndarray:
+        return (graph.in_degrees < self.k).astype(bool)
+
+    def gather(self, src_vals, weights):
+        return jnp.ones_like(src_vals)   # one decrement per removed in-edge
+
+    def apply(self, old, acc):
+        # Only still-alive vertices absorb decrements; removed ones stay
+        # frozen (acc can exceed a removed vertex's count — the wrapped
+        # subtraction is computed but discarded by the where).
+        return jnp.where(old >= jnp.uint32(self.k), old - acc, old)
+
+    def scatter(self, old, new):
+        k = jnp.uint32(self.k)
+        return (old >= k) & (new < k)
+
+    def finalize_host(self, graph: Graph, values: np.ndarray) -> dict:
+        alive = (values >= np.uint32(self.k)).astype(np.uint8)
+        return {"alive": alive, "core_size": int(alive.sum())}
+
+
+def reference_kcore(graph: Graph, k: int = 2) -> np.ndarray:
+    """Host numpy peeling oracle with the identical in-degree rule;
+    returns the frozen-degree array (values >= k <=> in the k-core)."""
+    nv = graph.nv
+    src = graph.col_src
+    dst = graph.col_dst
+    deg = graph.in_degrees.astype(np.int64).copy()
+    frontier = deg < k
+    while frontier.any():
+        sel = frontier[src]
+        dec = np.bincount(dst[sel], minlength=nv)
+        alive = deg >= k
+        new = np.where(alive, deg - dec, deg)
+        frontier = alive & (new < k)
+        deg = new
+    return deg.astype(np.uint32)

@@ -21,6 +21,7 @@ class SSSP(PushProgram):
     name = "sssp"
     combiner = "min"
     value_dtype = jnp.uint32
+    rooted = True
     packable_values = True     # distances <= nv < 2^31
 
     def init_values(self, graph: Graph, start: int = 0) -> np.ndarray:

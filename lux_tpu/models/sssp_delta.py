@@ -1,0 +1,88 @@
+"""Weighted single-source shortest paths, delta-stepping flavored
+(GAS model).
+
+The classic delta-stepping tradeoff — settle near buckets eagerly, defer
+far relaxations — exists to keep the *active set* small on work-list
+machines. On the dense-accelerator GAS engine the same knob is the
+direction choice: a small active set runs push (work scales with
+frontier out-edges, like a light-edge bucket pass), a large one runs
+pull (one dense O(ne) sweep relaxing every deferred edge at once). So
+this program is the monotone chunked Bellman-Ford whose fixpoint equals
+delta-stepping's, with the bucket discipline subsumed by the executor's
+density-adaptive switching rather than re-implemented as host-side
+bucket queues.
+
+Distances are float32 sums of int edge weights (generate.py weights are
+1..100), so every reachable distance on graphs this engine targets is an
+integer far below 2^24 — float32-exact, which keeps the host Dijkstra
+oracle bitwise-comparable and the min-combiner reassociation-safe.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+
+from lux_tpu.engine.gas import GasProgram
+from lux_tpu.graph.graph import Graph
+
+
+class DeltaSSSP(GasProgram):
+    name = "sssp_delta"
+    combiner = "min"
+    value_dtype = jnp.float32
+    needs_weights = True
+    rooted = True
+
+    def init_values(self, graph: Graph, start: int = 0) -> np.ndarray:
+        dist = np.full(graph.nv, np.inf, dtype=np.float32)
+        dist[start] = 0.0
+        return dist
+
+    def init_frontier(self, graph: Graph, start: int = 0) -> np.ndarray:
+        fr = np.zeros(graph.nv, dtype=bool)
+        fr[start] = True
+        return fr
+
+    def gather(self, src_vals, weights):
+        return src_vals + weights.astype(jnp.float32)
+
+    def edge_invariant(self, src_vals, dst_vals, weights):
+        return dst_vals <= src_vals + weights.astype(jnp.float32)
+
+
+def reference_sssp_delta(graph: Graph, start: int = 0) -> np.ndarray:
+    """Host Dijkstra oracle (float32 distances; unreached = +inf).
+    Exact match with the engine: all distances are small-int sums, so
+    float32 represents them without rounding."""
+    assert graph.weights is not None
+    csr = graph.csr()
+    dist = np.full(graph.nv, np.inf, dtype=np.float32)
+    dist[start] = 0.0
+    heap = [(0.0, start)]
+    while heap:
+        d, u = heapq.heappop(heap)
+        if d > dist[u]:
+            continue
+        for e in range(csr.row_ptr[u], csr.row_ptr[u + 1]):
+            v = int(csr.col_dst[e])
+            nd = np.float32(d + float(csr.weights[e]))
+            if nd < dist[v]:
+                dist[v] = nd
+                heapq.heappush(heap, (float(nd), v))
+    return dist
+
+
+def main(argv=None):
+    """CLI: python -m lux_tpu.models.sssp_delta -file g.lux -start R"""
+    from lux_tpu.models.cli import run_push_app
+
+    return run_push_app(DeltaSSSP(), argv, supports_start=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -48,7 +48,7 @@ class _NullRecorder:
         pass
 
     def flush(self, iters_done, frontier_sizes=None, active_edges=None,
-              residual=None, sparse_flags=None):
+              residual=None, sparse_flags=None, directions=None):
         pass
 
     def record_phase(self, iters_done, exchange_s, compute_s, detail=None,
@@ -107,6 +107,8 @@ def engine_label(ex) -> str:
         "MultiSourcePushExecutor": "push_multi",
         "ShardedMultiSourcePushExecutor": "push_multi_sharded",
         "IncrementalExecutor": "incremental",
+        "AdaptiveExecutor": "gas",
+        "MultiSourceGasExecutor": "gas_multi",
     }.get(name, name.lower())
 
 
@@ -306,12 +308,15 @@ class IterationRecorder:
                     branch=branch)
 
     def flush(self, iters_done, frontier_sizes=None, active_edges=None,
-              residual=None, sparse_flags=None):
+              residual=None, sparse_flags=None, directions=None):
         """Record the window since the previous flush. Call only right
         after a host sync; ``iters_done`` is the cumulative iteration
         count for the run so far. ``sparse_flags`` (push fixpoints) marks
         which window iterations took the sparse branch, adding per-record
-        branch, frontier-density, and dense/sparse crossover fields."""
+        branch, frontier-density, and dense/sparse crossover fields.
+        ``directions`` (GAS adaptive fixpoints) likewise marks which
+        window iterations ran push (1) vs pull (0) — the same branch/
+        crossover machinery then records every direction switch."""
         iters_done = int(iters_done)
         n = iters_done - self._iters
         if n <= 0:
@@ -330,6 +335,8 @@ class IterationRecorder:
             branch = None
             if sparse_flags is not None and j < len(sparse_flags):
                 branch = "sparse" if sparse_flags[j] else "dense"
+            if directions is not None and j < len(directions):
+                branch = "push" if directions[j] else "pull"
             ae = int(active_edges) if active_edges is not None else self.ne
             rec = {
                 "iter": it,

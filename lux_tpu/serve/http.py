@@ -2,7 +2,9 @@
 
 Endpoints:
 
-- ``POST /query`` — body ``{"app": "sssp", "start": 3}``; optional
+- ``POST /query`` — body ``{"app": "sssp", "start": 3}`` (apps from the
+  program registry: rooted apps take ``"start"``, pagerank ``"ni"``,
+  kcore ``"k"``); optional
   ``"deadline_s"`` (per-request deadline), ``"targets": [v, ...]``
   (return only those vertices' values) or ``"full": true`` (the whole
   value array — gated by a size cap so a misdirected client cannot pull
@@ -81,9 +83,19 @@ def _jsonable(v):
 
 
 def render_result(result: dict, body: dict, nv: int) -> dict:
-    """Shape one engine result for the wire: targets / full / summary."""
+    """Shape one engine result for the wire: targets / full / summary.
+
+    Per-vertex extras beyond ``values`` (GAS host finalizations: BFS
+    ``parent``, labelprop ``labels``, kcore ``alive``) follow the same
+    mode as ``values`` — sliced under ``targets``, whole under ``full``,
+    dropped in summary mode — so the size cap governs them too. Scalar
+    extras (iters, direction split, num_communities, ...) always pass."""
     vals = result["values"]
-    out = {k: _jsonable(v) for k, v in result.items() if k != "values"}
+    extras = {k: v for k, v in result.items()
+              if k != "values" and isinstance(v, np.ndarray)
+              and v.shape == (nv,)}
+    out = {k: _jsonable(v) for k, v in result.items()
+           if k != "values" and k not in extras}
     targets = body.get("targets")
     if targets is not None:
         targets = [int(t) for t in targets]
@@ -92,6 +104,8 @@ def render_result(result: dict, body: dict, nv: int) -> dict:
             raise BadQueryError(f"targets out of range [0, {nv}): {bad}")
         out["targets"] = targets
         out["values"] = [_jsonable(vals[t]) for t in targets]
+        for k, v in extras.items():
+            out[k] = [_jsonable(v[t]) for t in targets]
     elif body.get("full"):
         if nv > FULL_VALUES_CAP:
             raise BadQueryError(
@@ -99,6 +113,8 @@ def render_result(result: dict, body: dict, nv: int) -> dict:
                 "use 'targets'"
             )
         out["values"] = vals.tolist()
+        for k, v in extras.items():
+            out[k] = v.tolist()
     else:
         out["summary"] = {
             "min": _jsonable(vals.min()),
@@ -203,7 +219,7 @@ class _Handler(BaseHTTPRequestHandler):
                 app = body.get("app")
                 params = {
                     k: v for k, v in body.items()
-                    if k in ("start", "ni")
+                    if k in ("start", "ni", "k")
                 }
                 result = self.session.query(
                     app, deadline_s=body.get("deadline_s"), **params

@@ -123,6 +123,13 @@ class Session:
             self.store = SnapshotStore(graph,
                                        wal_dir=flags.get("LUX_WAL_DIR"))
         self._serving = self.store.current()  # luxlint: publish=_swap_lock
+        # The served app list derives from the program registry (every
+        # ``servable`` program routes: rooted GAS apps through the
+        # micro-batcher, GAS fixpoints through the result cache;
+        # weighted-only programs drop off when the graph is unweighted)
+        # — shadowing the class-level legacy triple.
+        self.APPS, self._gas_rooted, self._gas_fixpoints = (
+            self._compute_apps())
         self._degraded = None  # luxlint: publish=_swap_lock
         self._swap_lock = make_lock("session.swap")
         self.breaker = CircuitBreaker(self._breaker_probe)
@@ -284,6 +291,83 @@ class Session:
             self._engine_key("pull", snap, ("pagerank",)), build
         )
 
+    # -- GAS apps (direction-optimizing adaptive executor) ----------------
+
+    def _compute_apps(self):
+        """(apps, rooted_gas, fixpoint_gas) derived from the registry.
+
+        The legacy triple keeps its order (and its dedicated push/pull
+        routes below); programs beyond it serve through the adaptive GAS
+        executor. Anything marked ``servable = False`` (colfilter: needs
+        a bipartite ratings graph, not the served one) is skipped, as are
+        weight-consuming programs when the serving graph has no weights.
+        """
+        from lux_tpu.engine.gas import GasProgram
+        from lux_tpu.models import PROGRAMS
+
+        weighted = self._serving.graph.weighted
+        legacy = list(Session.APPS)
+        apps, rooted, fixpoints = [], [], []
+        for name in legacy + sorted(set(PROGRAMS) - set(legacy)):
+            cls = PROGRAMS[name]
+            if not getattr(cls, "servable", True):
+                continue
+            if getattr(cls, "needs_weights", False) and not weighted:
+                continue
+            if name in legacy:
+                apps.append(name)
+                continue
+            if not issubclass(cls, GasProgram):
+                continue   # no GAS route for it; not served
+            apps.append(name)
+            if getattr(cls, "rooted", False):
+                rooted.append(name)
+            else:
+                fixpoints.append(name)
+        return tuple(apps), tuple(rooted), tuple(fixpoints)
+
+    def _gas_program(self, app: str, extra=()):
+        """Instantiate the GAS program for ``app``; ``extra`` carries
+        per-engine parameters beyond the defaults (kcore's k)."""
+        from lux_tpu.engine.gas import as_gas
+        from lux_tpu.models import get_program
+
+        if app == "kcore" and extra:
+            from lux_tpu.models.kcore import KCore
+
+            return as_gas(KCore(k=int(extra[0])))
+        return as_gas(get_program(app))
+
+    def _gas_key_extra(self, app: str, extra=()) -> tuple:
+        return (app,) + tuple(extra) + (1,)
+
+    def _gas_single(self, app: str, snap: Optional[Snapshot] = None,
+                    extra=()):
+        # GAS engines run single-device even on a sharded session: the
+        # adaptive executor's per-iteration direction flip has no sharded
+        # counterpart yet (tracked as a ROADMAP follow-up), and a wrong
+        # single-chip answer would be worse than a slower correct one.
+        from lux_tpu.engine.gas import AdaptiveExecutor
+
+        snap = snap or self._serving
+        return self.pool.get(
+            self._engine_key("gas", snap, self._gas_key_extra(app, extra)),
+            lambda: AdaptiveExecutor(
+                snap.graph, self._gas_program(app, extra)),
+        )
+
+    def _gas_multi(self, app: str, snap: Optional[Snapshot] = None):
+        from lux_tpu.engine.gas import MultiSourceGasExecutor
+        from lux_tpu.models import get_program
+
+        snap = snap or self._serving
+        k = self.config.max_batch
+        return self.pool.get(
+            self._engine_key("gas_multi", snap, (app, k)),
+            lambda: MultiSourceGasExecutor(
+                snap.graph, get_program(app), k=k),
+        )
+
     def warmup(self, snap: Optional[Snapshot] = None):
         """Build + compile every served engine before traffic arrives
         (for ``snap``, default the serving snapshot — the hot-swap warms
@@ -301,6 +385,18 @@ class Session:
                 self._components_engine(snap)
             with _timed(self.log, "warmup pagerank"):
                 self._pagerank_engine(snap)
+            for app in self._gas_rooted:
+                with _timed(self.log, f"warmup {app} gas"):
+                    self._gas_single(app, snap)
+                with _timed(self.log, f"warmup {app} gas multi"):
+                    self._gas_multi(app, snap)
+            for app in self._gas_fixpoints:
+                # kcore's default k is baked into the warm engine key so
+                # default-parameter queries hit it; non-default k builds
+                # (and warms) a sibling engine on first use.
+                extra = (2,) if app == "kcore" else ()
+                with _timed(self.log, f"warmup {app} gas"):
+                    self._gas_single(app, snap, extra=extra)
 
     # -- query front door ------------------------------------------------
 
@@ -358,7 +454,7 @@ class Session:
                     lambda dl=None: self._run_components(snap, dl),
                     deadline, snap,
                 )
-            else:
+            elif app == "pagerank":
                 ni = int(params.get("ni", self.config.pagerank_iters))
                 if ni < 1:
                     raise BadQueryError(
@@ -367,6 +463,30 @@ class Session:
                 fut = self._submit_cached_fixpoint(
                     app, ("pagerank", ni),
                     lambda dl=None: self._run_pagerank(ni, snap, dl),
+                    deadline, snap,
+                )
+            elif app in self._gas_rooted:
+                fut = self._submit_rooted_gas(app, params, deadline, snap)
+            elif app == "kcore":
+                try:
+                    k = int(params.get("k", 2))
+                except (TypeError, ValueError):
+                    raise BadQueryError("kcore k must be an integer")
+                if k < 1:
+                    raise BadQueryError(
+                        f"kcore k must be >= 1 (got {k})"
+                    )
+                fut = self._submit_cached_fixpoint(
+                    app, ("kcore", k),
+                    lambda dl=None: self._run_gas_fixpoint(
+                        app, snap, dl, extra=(k,)),
+                    deadline, snap,
+                )
+            else:
+                # Remaining registry-derived fixpoints (labelprop today).
+                fut = self._submit_cached_fixpoint(
+                    app, (app,),
+                    lambda dl=None: self._run_gas_fixpoint(app, snap, dl),
                     deadline, snap,
                 )
         except BaseException:
@@ -413,6 +533,33 @@ class Session:
         req = Request(
             app="sssp", payload=(snap, start),
             batch_key=("sssp", snap.fingerprint, self.config.max_batch),
+            deadline=deadline,
+        )
+        return self.batcher.submit(req)
+
+    def _submit_rooted_gas(self, app: str, params: dict, deadline,
+                           snap: Snapshot) -> Future:
+        """Rooted GAS apps (bfs, sssp_delta) ride the same micro-batch
+        machinery as sssp: per-root result cache, fingerprinted batch
+        key, K-lane dense sweep when a window coalesces."""
+        try:
+            start = int(params["start"])
+        except (KeyError, TypeError, ValueError):
+            raise BadQueryError(f"{app} needs an integer 'start' root")
+        nv = snap.graph.nv
+        if not 0 <= start < nv:
+            raise BadQueryError(
+                f"{app} start {start} out of range [0, {nv})"
+            )
+        key = (snap.fingerprint, app, start)
+        hit = self.cache.get(key)
+        if hit is not None:
+            fut: Future = Future()
+            fut.set_result(hit)
+            return fut
+        req = Request(
+            app=app, payload=(snap, start),
+            batch_key=(app, snap.fingerprint, self.config.max_batch),
             deadline=deadline,
         )
         return self.batcher.submit(req)
@@ -502,6 +649,9 @@ class Session:
         if batch[0].app == "sssp":
             self._execute_sssp_batch(batch)
             return
+        if batch[0].app in self._gas_rooted:
+            self._execute_gas_batch(batch)
+            return
         if batch[0].app == "_drain":
             # Hot-swap barrier: FIFO ordering means every request admitted
             # before the swap flipped the serving pointer has already been
@@ -561,6 +711,53 @@ class Session:
             self._cache_put((snap.fingerprint, "sssp", root), out)
             r.future.set_result(out)
 
+    def _execute_gas_batch(self, batch: List[Request]):
+        """Rooted GAS batch: one lane runs the direction-adaptive engine
+        (and reports its push/pull split); a coalesced window runs the
+        K-lane dense multi-source sweep. Per-root host finalization
+        (BFS parents, ...) merges into each result dict."""
+        app = batch[0].app
+        snap = batch[0].payload[0]
+        roots = [r.payload[1] for r in batch]
+        deadlines = [r.deadline for r in batch if r.deadline is not None]
+        deadline = min(deadlines) if deadlines else None
+        prog = self._gas_program(app)
+        if len(batch) == 1:
+            key = self._engine_key("gas", snap, self._gas_key_extra(app))
+            ex = self._gas_single(app, snap)
+
+            def run_engine():
+                with spans.span("serve.engine", app=app, engine="gas",
+                                lanes=1):
+                    state, iters = ex.run(start=roots[0])
+                    dirs = {
+                        "direction_push": int(ex.push_iters),
+                        "direction_pull": int(ex.pull_iters),
+                        "direction_switches": int(ex.direction_switches),
+                    }
+                    return [np.asarray(state.values)], int(iters), dirs
+        else:
+            key = self._engine_key(
+                "gas_multi", snap, (app, self.config.max_batch)
+            )
+            ex = self._gas_multi(app, snap)
+
+            def run_engine():
+                with spans.span("serve.engine", app=app,
+                                engine="gas_multi", lanes=len(roots)):
+                    state, iters = ex.run(roots)
+                    return [
+                        ex.values_for(state, j) for j in range(len(roots))
+                    ], int(iters), {}
+        results, iters, dirs = self._engine_execute(
+            app, snap, key, deadline, run_engine)
+        for r, root, vals in zip(batch, roots, results):
+            out = {"values": vals, "iters": iters, "start": root}
+            out.update(dirs)
+            out.update(prog.finalize_host(snap.graph, vals))
+            self._cache_put((snap.fingerprint, app, root), out)
+            r.future.set_result(out)
+
     def _run_components(self, snap: Snapshot,
                         deadline: Optional[float] = None) -> dict:
         ex = self._components_engine(snap)
@@ -592,6 +789,30 @@ class Session:
         return self._engine_execute("pagerank", snap, key, deadline,
                                     run_engine)
 
+    def _run_gas_fixpoint(self, app: str, snap: Snapshot,
+                          deadline: Optional[float] = None,
+                          extra=()) -> dict:
+        """Root-free GAS fixpoint (labelprop, kcore): one adaptive run
+        to convergence, host finalization merged into the cached dict."""
+        ex = self._gas_single(app, snap, extra=extra)
+        key = self._engine_key("gas", snap, self._gas_key_extra(app, extra))
+        prog = self._gas_program(app, extra)
+
+        def run_engine():
+            with spans.span("serve.engine", app=app, engine="gas"):
+                state, iters = ex.run()
+                vals = np.asarray(state.values)
+                out = {
+                    "values": vals, "iters": int(iters),
+                    "direction_push": int(ex.push_iters),
+                    "direction_pull": int(ex.pull_iters),
+                    "direction_switches": int(ex.direction_switches),
+                }
+                out.update(prog.finalize_host(snap.graph, vals))
+                return out
+
+        return self._engine_execute(app, snap, key, deadline, run_engine)
+
     # -- circuit-breaker probe ---------------------------------------------
 
     def _breaker_probe(self, bkey) -> bool:
@@ -616,6 +837,23 @@ class Session:
                 key = self._engine_key("push", snap, ("components", 1))
                 self.pool.retire(lambda k: k == key)
                 ex = self._components_engine(snap)
+                with self.pool.sentinel.expect(("probe",) + key):
+                    faults.point("serve.engine.execute")
+                    ex.run()
+            elif app in self._gas_rooted:
+                key = self._engine_key(
+                    "gas", snap, self._gas_key_extra(app))
+                self.pool.retire(lambda k: k == key)
+                ex = self._gas_single(app, snap)
+                with self.pool.sentinel.expect(("probe",) + key):
+                    faults.point("serve.engine.execute")
+                    ex.run(start=0)
+            elif app in self._gas_fixpoints:
+                extra = (2,) if app == "kcore" else ()
+                key = self._engine_key(
+                    "gas", snap, self._gas_key_extra(app, extra))
+                self.pool.retire(lambda k: k == key)
+                ex = self._gas_single(app, snap, extra=extra)
                 with self.pool.sentinel.expect(("probe",) + key):
                     faults.point("serve.engine.execute")
                     ex.run()
@@ -1077,6 +1315,10 @@ class Session:
             "cache_hit_rate": (c["hits"] / probes) if probes else None,
             "batch_size": self.batcher.batch_histogram(),
             "mesh": self._mesh_block(),
+            # Latest adaptive-executor direction split (push/pull iters,
+            # mid-run switches) per GAS engine kind; {} until one runs.
+            "gas": {kind: rec for kind, rec in engobs.latest().items()
+                    if kind.startswith("gas")},
             "counters": {
                 "requests": int(self._requests.value),
                 "rejected": b["rejected"],

@@ -194,6 +194,20 @@ define("LUX_GROUPED_TAIL", False,
        "opt-in grouped (merge-network) tail phase in the tiled executors",
        kind="bool")
 
+# GAS adaptive executor (engine/gas.py)
+define("LUX_GAS", "adaptive",
+       "GAS executor direction policy: 'adaptive' picks push vs pull per "
+       "iteration from frontier density; 'pull'/'push' pin one direction "
+       "(results are bitwise-identical across all three)")
+define("LUX_GAS_DENSITY_HI", 0.0625,
+       "adaptive GAS hysteresis: frontier density at or above this forces "
+       "the pull (dense) direction (the reference's nv/16 crossover, "
+       "sssp_gpu.cu:414)", kind="float")
+define("LUX_GAS_DENSITY_LO", 0.005,
+       "adaptive GAS hysteresis: frontier density at or below this forces "
+       "the push (sparse-queue) direction; between the two thresholds the "
+       "previous direction sticks", kind="float")
+
 # bench.py suite knobs
 define("LUX_BENCH_SCALE", 22, "bench.py R-MAT scale", kind="int")
 define("LUX_BENCH_EF", 16, "bench.py R-MAT edge factor", kind="int")

@@ -1,0 +1,316 @@
+"""GAS subsystem: direction-optimizing adaptive executor, the widened
+program registry (BFS, weighted delta-SSSP, label propagation, k-core),
+legacy-model adapters, direction telemetry, and serving integration.
+
+The load-bearing contract under test: pull, push, and adaptive schedules
+produce **bitwise-equal** values for every frontier program (both
+directions materialize the same dense accumulator), and the adaptive
+policy actually switches direction mid-run on frontier curves that cross
+the hysteresis band.
+"""
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine.gas import (
+    AdaptiveExecutor,
+    GasProgram,
+    MultiSourceGasExecutor,
+    PullGasAdapter,
+    PushGasAdapter,
+    as_gas,
+)
+from lux_tpu.engine.push import PushExecutor
+from lux_tpu.graph import generate
+from lux_tpu.models import ENGINE_KINDS, PROGRAMS, ROOTED_APPS, get_program
+from lux_tpu.models.bfs import BFS, bfs_parents, reference_bfs
+from lux_tpu.models.kcore import KCore, reference_kcore
+from lux_tpu.models.labelprop import LabelPropagation, reference_labelprop
+from lux_tpu.models.pagerank import PageRank, reference_pagerank
+from lux_tpu.models.sssp import SSSP, reference_sssp
+from lux_tpu.models.sssp_delta import DeltaSSSP, reference_sssp_delta
+from lux_tpu.obs.iterlog import IterationRecorder
+from lux_tpu.serve import ServeConfig, Session
+
+
+def _rmat_w(scale=9, seed=3):
+    return generate.undirected(
+        generate.rmat(scale, 8, seed=seed, weighted=True))
+
+
+def _run_values(program, g, mode, **init_kw):
+    ex = AdaptiveExecutor(g, program, mode=mode)
+    state, iters = ex.run(**init_kw)
+    return np.asarray(state.values), iters, ex
+
+
+# -- host-oracle parity per program ---------------------------------------
+
+
+def test_bfs_matches_oracle():
+    g = _rmat_w()
+    depth_ref, parent_ref = reference_bfs(g, 1)
+    ex = AdaptiveExecutor(g, BFS())
+    state, _ = ex.run(start=1)
+    np.testing.assert_array_equal(np.asarray(state.values), depth_ref)
+    np.testing.assert_array_equal(ex.finalize(state)["parent"], parent_ref)
+
+
+def test_sssp_delta_matches_dijkstra():
+    g = _rmat_w()
+    vals, _, _ = _run_values(DeltaSSSP(), g, "adaptive", start=0)
+    np.testing.assert_array_equal(vals, reference_sssp_delta(g, 0))
+
+
+def test_labelprop_matches_oracle():
+    g = _rmat_w()
+    vals, iters, ex = _run_values(LabelPropagation(), g, "adaptive")
+    np.testing.assert_array_equal(vals, reference_labelprop(g))
+    fin = LabelPropagation().finalize_host(g, vals)
+    assert fin["num_communities"] >= 1
+    np.testing.assert_array_equal(fin["labels"], vals >> np.uint32(8))
+
+
+def test_kcore_matches_peeling_oracle():
+    g = _rmat_w()
+    for k in (2, 3):
+        vals, _, ex = _run_values(KCore(k=k), g, "adaptive")
+        ref = reference_kcore(g, k)
+        np.testing.assert_array_equal(vals, ref)
+        fin = KCore(k=k).finalize_host(g, vals)
+        assert fin["core_size"] == int((ref >= k).sum())
+
+
+def test_kcore_rejects_bad_k():
+    with pytest.raises(ValueError):
+        KCore(k=0)
+
+
+# -- bitwise parity across directions -------------------------------------
+
+
+@pytest.mark.parametrize("make,init_kw", [
+    (BFS, {"start": 1}),
+    (DeltaSSSP, {"start": 0}),
+    (LabelPropagation, {}),
+    (KCore, {}),
+])
+def test_pinned_directions_bitwise_equal(make, init_kw):
+    """pull == push == adaptive, bit for bit, for every frontier
+    program: both directions build the same dense accumulator."""
+    g = _rmat_w()
+    pull, i_pull, _ = _run_values(make(), g, "pull", **init_kw)
+    push, i_push, _ = _run_values(make(), g, "push", **init_kw)
+    adap, i_adap, _ = _run_values(make(), g, "adaptive", **init_kw)
+    np.testing.assert_array_equal(pull, push)
+    np.testing.assert_array_equal(pull, adap)
+    assert i_pull == i_push == i_adap
+
+
+def test_bfs_adaptive_switches_mid_run():
+    """On an RMAT frontier curve (small wave -> big wave -> tail) the
+    adaptive policy must actually change direction at least once, and
+    the switch must not perturb the result."""
+    g = generate.undirected(generate.rmat(10, 8, seed=3, weighted=True))
+    vals, _, ex = _run_values(BFS(), g, "adaptive", start=1)
+    assert ex.direction_switches >= 1
+    assert ex.push_iters >= 1 and ex.pull_iters >= 1
+    pinned, _, _ = _run_values(BFS(), g, "pull", start=1)
+    np.testing.assert_array_equal(vals, pinned)
+
+
+# -- legacy adapters ------------------------------------------------------
+
+
+def test_push_adapter_sssp_bitwise_matches_push_engine():
+    g = generate.gnp(400, 3000, seed=103, weighted=True)
+    prog = as_gas(SSSP())
+    assert isinstance(prog, PushGasAdapter) and prog.rooted
+    vals, _, _ = _run_values(prog, g, "adaptive", start=5)
+    ref_state, _ = PushExecutor(g, SSSP()).run(start=5)
+    np.testing.assert_array_equal(vals, np.asarray(ref_state.values))
+    np.testing.assert_array_equal(vals, reference_sssp(g, 5))
+
+
+def test_pull_adapter_pagerank_matches_reference():
+    g = generate.gnp(300, 2400, seed=7)
+    prog = as_gas(PageRank())
+    assert isinstance(prog, PullGasAdapter) and not prog.frontier
+    ex = AdaptiveExecutor(g, prog)
+    assert ex.mode == "pull"    # frontier-less: direction is forced
+    state, iters = ex.run(max_iters=20)
+    assert iters == 20
+    np.testing.assert_allclose(
+        np.asarray(state.values), reference_pagerank(g, 20),
+        rtol=1e-5, atol=1e-7)
+
+
+def test_frontierless_run_requires_max_iters():
+    g = generate.gnp(50, 200, seed=1)
+    ex = AdaptiveExecutor(g, as_gas(PageRank()))
+    with pytest.raises(ValueError):
+        ex.run()
+
+
+def test_as_gas_rejects_unknown_model():
+    with pytest.raises(TypeError):
+        as_gas(object())
+
+
+def test_bad_mode_rejected():
+    g = generate.gnp(50, 200, seed=1)
+    with pytest.raises(ValueError):
+        AdaptiveExecutor(g, BFS(), mode="sideways")
+
+
+# -- multi-source batching ------------------------------------------------
+
+
+def test_multi_source_gas_matches_single_lanes():
+    g = _rmat_w()
+    roots = [2, 3, 4]
+    mx = MultiSourceGasExecutor(g, BFS(), k=4)   # k > len(roots): padding
+    state, _ = mx.run(roots)
+    for j, r in enumerate(roots):
+        single, _, _ = _run_values(BFS(), g, "adaptive", start=r)
+        np.testing.assert_array_equal(mx.values_for(state, j), single)
+        fin = mx.finalize_for(state, j)
+        np.testing.assert_array_equal(
+            fin["parent"], bfs_parents(g, single))
+
+
+def test_multi_source_gas_rejects_frontierless():
+    g = generate.gnp(50, 200, seed=1)
+    with pytest.raises(ValueError):
+        MultiSourceGasExecutor(g, PageRank(), k=2)
+
+
+# -- registry derivation --------------------------------------------------
+
+
+def test_rooted_apps_derived_from_program_attr():
+    assert ROOTED_APPS == frozenset({"bfs", "sssp", "sssp_delta"})
+    for name in ROOTED_APPS:
+        assert getattr(PROGRAMS[name], "rooted", False)
+
+
+def test_registry_gas_coverage():
+    """Every registered program runs under some GAS kind, and every
+    gas_multi program is rooted."""
+    for name, kinds in ENGINE_KINDS.items():
+        assert any(k.startswith("gas") for k in kinds), name
+        if "gas_multi" in kinds:
+            assert name in ROOTED_APPS
+    # the registry instantiates cleanly through the one factory
+    for name in PROGRAMS:
+        assert get_program(name).name == name
+
+
+# -- direction telemetry --------------------------------------------------
+
+
+def test_recorder_directions_feed_crossovers():
+    rec = IterationRecorder("gas", nv=100, ne=800, program="BFS")
+    rec.start()
+    rec.flush(3, frontier_sizes=[1, 10, 60], directions=[1, 1, 0])
+    rec.flush(5, frontier_sizes=[8, 2], directions=[1, 1])
+    s = rec.finish()
+    branches = [r["branch"] for r in s["iterations"]]
+    assert branches == ["push", "push", "pull", "push", "push"]
+    assert [(c["from"], c["to"]) for c in s["crossovers"]] == [
+        ("push", "pull"), ("pull", "push")]
+
+
+def test_adaptive_run_notes_direction_split():
+    from lux_tpu.obs import engobs
+
+    g = _rmat_w()
+    _, iters, ex = _run_values(BFS(), g, "adaptive", start=1)
+    latest = engobs.latest().get("gas")
+    assert latest is not None
+    assert latest["num_iters"] == iters
+    assert latest["direction_push"] == ex.push_iters
+    assert latest["direction_pull"] == ex.pull_iters
+    assert latest["direction_switches"] == ex.direction_switches
+    assert latest["direction_push"] + latest["direction_pull"] == iters
+
+
+# -- serving integration --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gas_session():
+    g = _rmat_w(scale=8, seed=5)
+    s = Session(g, ServeConfig(max_batch=4, window_s=0.001))
+    yield s, g
+    s.close()
+
+
+def test_session_apps_derived_from_registry(gas_session):
+    s, _ = gas_session
+    assert set(s.APPS) >= {"sssp", "components", "pagerank", "bfs",
+                           "sssp_delta", "labelprop", "kcore"}
+    assert "colfilter" not in s.APPS   # servable = False
+    assert s._gas_rooted == ("bfs", "sssp_delta")
+
+
+def test_session_unweighted_graph_drops_weighted_apps():
+    g = generate.gnp(200, 1200, seed=11)   # unweighted
+    s = Session(g, ServeConfig(max_batch=2, window_s=0.001))
+    try:
+        assert "sssp_delta" not in s.APPS
+        assert "bfs" in s.APPS
+    finally:
+        s.close()
+
+
+def test_session_serves_gas_apps_with_oracle_agreement(gas_session):
+    s, g = gas_session
+    r = s.query("bfs", start=1)
+    depth, parent = reference_bfs(g, 1)
+    np.testing.assert_array_equal(r["values"], depth)
+    np.testing.assert_array_equal(r["parent"], parent)
+    assert r["direction_push"] + r["direction_pull"] == r["iters"]
+
+    r = s.query("sssp_delta", start=0)
+    np.testing.assert_array_equal(r["values"], reference_sssp_delta(g, 0))
+
+    r = s.query("labelprop")
+    np.testing.assert_array_equal(r["values"], reference_labelprop(g))
+    assert r["num_communities"] == np.unique(r["labels"]).size
+
+    r = s.query("kcore", k=3)
+    ref = reference_kcore(g, 3)
+    np.testing.assert_array_equal(r["values"], ref)
+    assert r["core_size"] == int((ref >= 3).sum())
+
+
+def test_session_gas_batch_lanes_match_singles(gas_session):
+    s, g = gas_session
+    roots = [2, 3, 4, 5]
+    futs = [s.submit("bfs", start=r) for r in roots]
+    for r, f in zip(roots, futs):
+        out = f.result(timeout=60)
+        depth, parent = reference_bfs(g, r)
+        np.testing.assert_array_equal(out["values"], depth)
+        np.testing.assert_array_equal(out["parent"], parent)
+
+
+def test_session_kcore_validates_k(gas_session):
+    from lux_tpu.serve import BadQueryError
+
+    s, _ = gas_session
+    with pytest.raises(BadQueryError):
+        s.query("kcore", k=0)
+    with pytest.raises(BadQueryError):
+        s.query("kcore", k="three")
+
+
+def test_statusz_carries_gas_direction_split(gas_session):
+    s, _ = gas_session
+    s.query("bfs", start=6)
+    block = s.statusz()["gas"]
+    assert "gas" in block
+    rec = block["gas"]
+    assert rec["direction_push"] + rec["direction_pull"] \
+        == rec["num_iters"]

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -27,7 +27,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -69,6 +69,13 @@ serve-sharded-smoke:
 # single-lane BFS, zero recompiles, /statusz direction-split block.
 gas-smoke:
 	python tools/gas_smoke.py
+
+# Compacted-exchange acceptance (LUX_EXCHANGE=compact): bitwise parity
+# full-vs-compact for SSSP + PageRank on a 2x4 virtual mesh, >= 5x
+# exchange-byte drop on the halo locality graph, zero recompiles, and
+# a phase-fenced exchange_hidden_frac report.
+exchange-smoke:
+	python tools/exchange_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

@@ -258,6 +258,32 @@ def bench_cc(g):
     return bench_push(g, ConnectedComponents(), "cc", 32)
 
 
+def bench_gas(g, program, tag: str, max_iters: int, **init_kw):
+    """Shared GAS-app fixpoint bench (BFS, delta-SSSP, label
+    propagation, k-core): the push-bench timing discipline through the
+    direction-adaptive executor."""
+    from lux_tpu.engine.gas import AdaptiveExecutor
+
+    ex = AdaptiveExecutor(g, program)
+    ex.warmup(**init_kw)
+    t0 = time.perf_counter()
+    state, iters = ex.run(max_iters=max_iters, **init_kw)
+    elapsed = time.perf_counter() - t0
+    gteps = lux_gteps(g.ne, iters, elapsed)
+    log(
+        f"{tag}: {iters} iters ({ex.push_iters} push/{ex.pull_iters} "
+        f"pull, {ex.direction_switches} switches) in {elapsed:.2f}s "
+        f"({gteps:.3f} GTEPS)"
+    )
+    return {
+        "gteps": round(gteps, 4),
+        "iters": iters,
+        "push_iters": ex.push_iters,
+        "direction_switches": ex.direction_switches,
+        "ms_per_iter": round(elapsed / max(iters, 1) * 1e3, 2),
+    }
+
+
 def bench_cf(g, iters: int = 5):
     from lux_tpu.engine.pull import PullExecutor, hard_sync
     from lux_tpu.models.colfilter import CollaborativeFiltering
@@ -404,10 +430,48 @@ def main():
             )
             return bench_cc(g_u)
 
+        def run_sssp_delta():
+            from lux_tpu.models.sssp_delta import DeltaSSSP
+
+            g_w = cached_graph(
+                cache, f"rmat{scale}_{ef}_weighted",
+                lambda: generate.rmat(scale, ef, seed=42, weighted=True),
+                remaining=remaining(), gen_cost=gen_cost,
+            )
+            return bench_gas(g_w, DeltaSSSP(), "sssp_delta", 32, start=0)
+
+        def run_bfs():
+            from lux_tpu.models.bfs import BFS
+
+            return bench_gas(g, BFS(), "bfs", 32, start=0)
+
+        def run_labelprop():
+            from lux_tpu.models.labelprop import LabelPropagation
+
+            return bench_gas(g, LabelPropagation(), "labelprop", 16)
+
+        def run_kcore():
+            from lux_tpu.models.kcore import KCore
+
+            # Coreness is an undirected notion — reuse the CC closure
+            # (cache hit after cc_rmat generates it).
+            g_u = cached_graph(
+                cache, f"rmat{scale}_{ef}_undirected",
+                lambda: generate.undirected(g),
+                remaining=remaining(), gen_cost=2 * gen_cost,
+            )
+            return bench_gas(g_u, KCore(k=4), "kcore", 32)
+
         suite_item("sssp_rmat", lambda: bench_sssp(g))
         suite_item("pagerank_smallworld", run_smallworld)
         suite_item("cc_rmat", run_cc)
         suite_item("cf_bipartite", run_cf)
+        # GAS-engine apps (PR 12) join the ratchet so direction-adaptive
+        # regressions gate like everything else.
+        suite_item("bfs_rmat", run_bfs)
+        suite_item("sssp_delta_rmat", run_sssp_delta)
+        suite_item("labelprop_rmat", run_labelprop)
+        suite_item("kcore_rmat", run_kcore)
         # Deadline-skipped items fall back to the most recent completed
         # measurement of the SAME code (git HEAD match), clearly labeled
         # — tunnel upload/compile throughput varies run to run, and a

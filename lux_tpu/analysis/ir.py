@@ -490,9 +490,36 @@ def build_executor(kind: str, graph, program):
     raise ValueError(f"unknown executor kind {kind!r}")
 
 
+def _compact_graph(kind: str, weighted: bool, seed: int):
+    """Graph whose partition actually engages the compact exchange: the
+    row-granular engines need read locality (small_world's ring plus a
+    contiguous edge-balanced partition leaves only boundary reads), the
+    tiled engine needs hub concentration (rmat's Kronecker skew keeps
+    strip reads on the few hub blocks). The tiny gnp used for the plain
+    targets is all-remote at this size, which would fall back to full
+    and silently shrink audit coverage of the compact collectives."""
+    from lux_tpu.graph.generate import rmat, small_world
+    from lux_tpu.graph.graph import Graph
+
+    if kind == "tiled_sharded":
+        return rmat(12, 8, seed=seed, weighted=weighted)
+    g = small_world(1024, k=4, p_rewire=0.05, seed=seed)
+    if weighted:
+        rng = np.random.default_rng(seed)
+        g = Graph(nv=g.nv, ne=g.ne, row_ptr=g.row_ptr, col_src=g.col_src,
+                  weights=rng.integers(1, 101, g.ne, dtype=np.int32))
+    return g
+
+
 def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
-    """Trace targets for every registered program x capable executor."""
+    """Trace targets for every registered program x capable executor.
+    Sharded kinds are traced twice: once with the default full exchange
+    and once under ``LUX_EXCHANGE=compact`` (``{name}@{kind}+compact``),
+    so LUX104/LUX105 audit the packed all_to_all path too."""
+    import os
+
     from lux_tpu.models import PROGRAMS, ROOTED_APPS, engine_kinds
+    from lux_tpu.utils.logging import get_logger
 
     targets: List[TraceTarget] = []
     for i, name in enumerate(sorted(PROGRAMS)):
@@ -506,6 +533,28 @@ def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
             ex = build_executor(kind, graph, program)
             spec = ex.trace_step(**init_kw)
             targets.append(target_from_spec(f"{name}@{kind}", spec))
+            if not kind.endswith("sharded"):
+                continue
+            # luxlint: disable=LUX005 -- save/restore needs the raw set-vs-unset env entry, which the typed accessors erase
+            prev = os.environ.get("LUX_EXCHANGE")
+            os.environ["LUX_EXCHANGE"] = "compact"
+            try:
+                exc = build_executor(
+                    kind, _compact_graph(kind, weighted, 7 + i), program)
+            finally:
+                if prev is None:
+                    os.environ.pop("LUX_EXCHANGE", None)
+                else:
+                    os.environ["LUX_EXCHANGE"] = prev
+            if getattr(exc, "exchange_mode", "full") != "compact":
+                # Coverage loss must be visible, not silent.
+                get_logger("luxlint").warning(
+                    "%s@%s+compact fell back to the full exchange; "
+                    "compact collectives untraced for this target",
+                    name, kind)
+                continue
+            targets.append(target_from_spec(
+                f"{name}@{kind}+compact", exc.trace_step(**init_kw)))
     return targets
 
 

@@ -39,7 +39,8 @@ from lux_tpu.utils import compat
 from lux_tpu.utils.timing import Timer
 from lux_tpu.ops.segment import segment_reduce, segment_sum_by_rowptr
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
-from lux_tpu.parallel.shard import ShardedGraph
+from lux_tpu.parallel.shard import ShardedGraph, resolve_exchange
+from lux_tpu.utils.logging import get_logger
 
 
 class ShardedPullExecutor:
@@ -88,6 +89,13 @@ class ShardedPullExecutor:
             getattr(program, "value_shape", ())
         )
 
+        # Exchange mode is captured here, once: the jitted step traces a
+        # single program, and the serving pool keys engines by the mode
+        # (flags re-read env per call, so a later flip builds NEW
+        # engines rather than mutating this one).
+        self.exchange_mode, self._xplan = resolve_exchange(
+            self.sg, get_logger("engine"))
+
         sh = parts_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), sh)
         sgd = {
@@ -100,6 +108,9 @@ class ShardedPullExecutor:
         }
         if self.sg.weights is not None:
             sgd["weights"] = put(self.sg.weights)
+        if self._xplan is not None:
+            sgd["xch_send"] = put(self._xplan.send_units)
+            sgd["xch_recv"] = put(self._xplan.recv_pos)
         self._device_graph = sgd
 
         specs = {k: P(PARTS_AXIS) for k in sgd}
@@ -114,22 +125,44 @@ class ShardedPullExecutor:
 
     # -- per-shard body (runs under shard_map; block shapes (1, ...)) ----
 
-    def _exchange_block(self, vals_blk):
+    def _exchange_block(self, vals_blk, dg):
         """Value exchange: all-gather the shards into the flat global
         table every shard gathers from (the reference's whole-region
-        zero-copy read, pull_model.inl:454-461)."""
+        zero-copy read, pull_model.inl:454-461) — or, under
+        ``LUX_EXCHANGE=compact``, a fixed-capacity ``all_to_all`` of the
+        packed needed rows scattered into the same flat view (rows no
+        remote edge reads stay zero; the comp block routes local edges
+        to the shard's own values, so only genuinely remote reads touch
+        this table)."""
         v = vals_blk[0]                  # (max_nv, *t); lane-padded if _kpad
         kp, kr = self._kpad, self._kreal
         if kp:
             # Exchange the real lanes only; re-pad locally for fast
             # 512 B-row gathers from the flat table.
-            gathered = jax.lax.all_gather(v[:, :kr], PARTS_AXIS)
-            flat = gathered.reshape(-1, kr)
+            flat = self._flat_table(v[:, :kr], dg)
             flat = jnp.pad(flat, ((0, 0), (0, kp - kr)))
         else:
-            gathered = jax.lax.all_gather(v, PARTS_AXIS)  # (P, max_nv, *t)
-            flat = gathered.reshape((-1,) + v.shape[1:])
+            flat = self._flat_table(v, dg)
         return flat
+
+    def _flat_table(self, vv, dg):
+        """(P*max_nv, *t) flat value table from this shard's (max_nv, *t)
+        slice: whole-shard all_gather (full) or packed needed-rows
+        all_to_all + receiver scatter (compact)."""
+        if self._xplan is None:
+            gathered = jax.lax.all_gather(vv, PARTS_AXIS)
+            return gathered.reshape((-1,) + vv.shape[1:])
+        max_nv = self.sg.max_nv
+        packed = vv[jnp.minimum(dg["xch_send"][0], max_nv - 1)]
+        got = jax.lax.all_to_all(
+            packed, PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True
+        )
+        # Scatter into a (P*max_nv + 1)-row buffer: pad entries of the
+        # scatter map land on the final trash row, sliced off here.
+        buf = jnp.zeros(
+            (self.num_parts * max_nv + 1,) + vv.shape[1:], vv.dtype
+        )
+        return buf.at[dg["xch_recv"][0]].set(got)[:-1]
 
     def _comp_block(self, vals_blk, flat, dg):
         """Edge gather + contribution + per-destination reduction."""
@@ -142,15 +175,33 @@ class ShardedPullExecutor:
         # widths; pad lanes are zero, so contraction-style programs (CF's
         # dot/err*src) are unaffected, and narrow (ne, K) arrays pad to
         # the 128-lane tile physically anyway.
-        src_vals = flat[dg["src_pidx"][0]]
+        sidx = dg["src_pidx"][0]
         dst_ids = jnp.minimum(dg["dst_local"][0], max_nv - 1)
         dst_vals = v[dst_ids]
-        edge = EdgeCtx(
-            src_vals=src_vals,
-            dst_vals=dst_vals,
-            weights=dg["weights"][0] if "weights" in dg else None,
-        )
-        contrib = prog.edge_contrib(edge)
+        w = dg["weights"][0] if "weights" in dg else None
+
+        def contrib_from(src_vals):
+            return prog.edge_contrib(EdgeCtx(
+                src_vals=src_vals, dst_vals=dst_vals, weights=w,
+            ))
+
+        if self._xplan is None:
+            contrib = contrib_from(flat[sidx])
+        else:
+            # Local-first overlap: the local-edge contribution reads only
+            # this shard's values — no data dependence on the collective —
+            # so XLA can compute it while the packed exchange is in
+            # flight; the per-edge select (before the SINGLE unchanged
+            # reduction) folds the remote contribution in without
+            # reordering the combine, keeping results bitwise equal to
+            # the full path for every combiner, float sum included.
+            own = jax.lax.axis_index(PARTS_AXIS)
+            base = own * max_nv
+            local = (sidx >= base) & (sidx < base + max_nv)
+            c_local = contrib_from(v[jnp.clip(sidx - base, 0, max_nv - 1)])
+            c_remote = contrib_from(flat[sidx])
+            mask = local.reshape(local.shape + (1,) * (c_local.ndim - 1))
+            contrib = jnp.where(mask, c_local, c_remote)
         if prog.combiner == "sum" and self.sum_strategy == "rowptr":
             acc = segment_sum_by_rowptr(contrib, dg["local_row_ptr"][0])
         else:
@@ -188,7 +239,7 @@ class ShardedPullExecutor:
         return new[None]
 
     def _shard_step(self, vals_blk, dg):
-        flat = self._exchange_block(vals_blk)
+        flat = self._exchange_block(vals_blk, dg)
         acc = self._comp_block(vals_blk, flat, dg)
         return self._update_block(vals_blk, acc, dg)
 
@@ -220,6 +271,12 @@ class ShardedPullExecutor:
         timed loops."""
         if not hasattr(self, "_pjits"):
             specs = {k: P(PARTS_AXIS) for k in self._device_graph}
+            compact = self._xplan is not None
+            # Full mode: the all-gathered flat table is replicated, so
+            # the exchange phase hands one copy across. Compact mode:
+            # every shard scatters its OWN flat view (rows differ per
+            # receiver), so the table stays per-shard.
+            flat_spec = P(PARTS_AXIS) if compact else P()
 
             def sm(fn, in_specs, out_specs):
                 # check_vma off: the all-gathered flat table is
@@ -232,12 +289,17 @@ class ShardedPullExecutor:
 
             self._pjits = {
                 "exchange": sm(
-                    lambda v: self._exchange_block(v),
-                    (P(PARTS_AXIS),), P(),
+                    lambda v, dg: (
+                        self._exchange_block(v, dg)[None] if compact
+                        else self._exchange_block(v, dg)
+                    ),
+                    (P(PARTS_AXIS), specs), flat_spec,
                 ),
                 "comp": sm(
-                    lambda v, flat, dg: self._comp_block(v, flat, dg)[None],
-                    (P(PARTS_AXIS), P(), specs), P(PARTS_AXIS),
+                    lambda v, flat, dg: self._comp_block(
+                        v, flat[0] if compact else flat, dg
+                    )[None],
+                    (P(PARTS_AXIS), flat_spec, specs), P(PARTS_AXIS),
                 ),
                 "update": sm(
                     lambda v, acc, dg: self._update_block(v, acc[0], dg),
@@ -246,7 +308,7 @@ class ShardedPullExecutor:
             }
         j, dg, times = self._pjits, self._device_graph, {}
         with Timer() as t:
-            flat = hard_sync(j["exchange"](vals))
+            flat = hard_sync(j["exchange"](vals, dg))
         times["exchange"] = t.elapsed
         with Timer() as t:
             acc = hard_sync(j["comp"](vals, flat, dg))
@@ -274,18 +336,24 @@ class ShardedPullExecutor:
             "sharded": True,
         }
 
-    def _exchange_bytes_per_iter(self) -> int:
-        """ICI bytes moved by one iteration's all-gather: each of the P
-        shards sends its (max_nv, kreal-or-scalar) slice to the P-1
-        others (``_exchange_block`` gathers only real lanes when
-        lane-padded)."""
+    def _row_bytes(self) -> int:
         try:
             itemsize = np.dtype(self.program.value_dtype).itemsize
         except (AttributeError, TypeError):
             itemsize = 4
-        width = max(self._kreal, 1)
+        return max(self._kreal, 1) * itemsize
+
+    def _exchange_bytes_per_iter(self) -> int:
+        """ICI bytes moved by one iteration's exchange. Full: each of
+        the P shards sends its (max_nv, kreal-or-scalar) slice to the
+        P-1 others (``_exchange_block`` gathers only real lanes when
+        lane-padded). Compact: the packed-capacity figure — what the
+        fixed-capacity all_to_all actually moves."""
+        row = self._row_bytes()
+        if self._xplan is not None:
+            return self._xplan.exchange_bytes_per_iter(row)
         p = self.num_parts
-        return p * (p - 1) * self.sg.max_nv * width * itemsize
+        return p * (p - 1) * self.sg.max_nv * row
 
     def exchange_bytes_per_iter(self) -> int:
         """Public form of the per-iteration exchange estimate (the
@@ -301,9 +369,13 @@ class ShardedPullExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            compact = self._xplan is not None
             rec.set_exchange_bytes(
-                self._exchange_bytes_per_iter(), note="all_gather",
+                self._exchange_bytes_per_iter(),
+                note="compact_all_to_all" if compact else "all_gather",
                 parts=self.num_parts)
+            if compact:
+                rec.set_overlap(True)
             self._note_ledger(rec)
         if engobs.enabled():
             # Phase-fenced measurement run: exchange/compute split per
@@ -326,7 +398,10 @@ class ShardedPullExecutor:
         except (AttributeError, TypeError):
             itemsize = 4
         width = max(self._kreal, 1)
-        useful = engobs.useful_exchange(self.sg, width * itemsize)
+        xrows = (self._xplan.exchanged_units_per_iter
+                 if self._xplan is not None else None)
+        useful = engobs.useful_exchange(self.sg, width * itemsize,
+                                        exchanged_rows=xrows)
         if useful is not None:
             rec.set_useful_bytes(useful["useful_bytes_per_iter"],
                                  useful["ratio"])

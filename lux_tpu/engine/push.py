@@ -56,8 +56,9 @@ from lux_tpu.obs import (
 )
 from lux_tpu.ops.segment import identity_for, segment_reduce
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
-from lux_tpu.parallel.shard import ShardedGraph
+from lux_tpu.parallel.shard import ShardedGraph, resolve_exchange
 from lux_tpu.utils import compat
+from lux_tpu.utils.logging import get_logger
 from lux_tpu.utils.timing import Timer
 
 class PushProgram:
@@ -939,16 +940,28 @@ class ShardedPushExecutor:
         # serves its edges from the all-gathered packed (value,
         # frontier-bit) table via row gathers + lane select and reduces
         # with the segmented min/max scan over its local CSC.
+        log = get_logger("engine")
+        self.exchange_mode, self._xplan = resolve_exchange(self.sg, log)
         flat_nv = self.num_parts * self.sg.max_nv
         if blocked_dense is None:
+            # The packed blocked path gathers the whole (value | frontier
+            # bit) table; it has no needed-rows form, so the compact
+            # exchange takes precedence when both are viable.
             blocked_dense = (
-                graph.ne >= self.BLOCKED_DENSE_MIN_NE
+                self._xplan is None
+                and graph.ne >= self.BLOCKED_DENSE_MIN_NE
                 and getattr(program, "packable_values", False)
                 and program.value_dtype == jnp.uint32
                 and flat_nv < 2**31
                 and self.sg.max_ne < 2**31
             )
         elif blocked_dense:
+            if self._xplan is not None:
+                log.info(
+                    "LUX_EXCHANGE=compact has no packed blocked form; "
+                    "explicit blocked_dense=True keeps the full exchange"
+                )
+                self.exchange_mode, self._xplan = "full", None
             if program.value_dtype != jnp.uint32 or not getattr(
                 program, "packable_values", False
             ):
@@ -1019,6 +1032,9 @@ class ShardedPushExecutor:
             self._dg["dst_local"] = put(self.sg.dst_local)
             if self.sg.weights is not None:
                 self._dg["weights"] = put(self.sg.weights)
+        if self._xplan is not None:
+            self._dg["xch_send"] = put(self._xplan.send_units)
+            self._dg["xch_recv"] = put(self._xplan.recv_pos)
         self.sparse = sparse and graph.ne >= 1024
         if self.sparse:
             self.queue_cap, self.edge_budget = _sparse_budgets(
@@ -1060,13 +1076,37 @@ class ShardedPushExecutor:
             allp = jax.lax.all_gather(packed, PARTS_AXIS).reshape(-1)
             x2d = jnp.pad(allp, (0, (-allp.shape[0]) % 128)).reshape(-1, 128)
             return (x2d,)
+        if self._xplan is not None:
+            # Compact exchange: fixed-capacity all_to_all of the rows
+            # each receiver's real edges read (values + frontier bits),
+            # scattered into the flat view at the positions src_pidx
+            # indexes. Own-span rows stay zero — _dense_comp serves
+            # local edges straight from the shard (the local-first
+            # overlap branch), and unread remote rows carry frontier
+            # False, so their candidates collapse to the identity.
+            max_nv = self.sg.max_nv
+            sel = jnp.minimum(dg["xch_send"][0], max_nv - 1)
+            pv = jax.lax.all_to_all(
+                v[sel], PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True)
+            pf = jax.lax.all_to_all(
+                f[sel], PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True)
+            recv = dg["xch_recv"][0]
+            flat = self.num_parts * max_nv
+            all_v = jnp.zeros((flat + 1,), v.dtype).at[recv].set(pv)[:-1]
+            all_f = jnp.zeros((flat + 1,), f.dtype).at[recv].set(pf)[:-1]
+            return all_v, all_f
         all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1)
         all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1)
         return all_v, all_f
 
-    def _dense_comp(self, loaded, dg):
+    def _dense_comp(self, loaded, dg, state: Optional[PushState] = None):
         """Relax + per-local-destination reduction; returns (acc, edges)
-        where edges counts this shard's frontier-sourced edges."""
+        where edges counts this shard's frontier-sourced edges. Compact
+        exchange passes ``state`` so local-source edges relax against the
+        shard's own values — a branch with no collective dependence that
+        XLA overlaps with the in-flight all_to_all — selected per edge
+        against the remote branch before the unchanged reduction, which
+        keeps the combine order (and hence results) bitwise identical."""
         prog = self.program
         max_nv = self.sg.max_nv
         if self.blocked_dense:
@@ -1088,12 +1128,27 @@ class ShardedPushExecutor:
             return acc, jnp.int32(-1)   # frontier bits ride inside cands
         all_v, all_f = loaded
         sidx = dg["src_pidx"][0]
-        src_vals = all_v[sidx]
-        src_front = all_f[sidx]
         w = dg["weights"][0] if "weights" in dg else None
-        cand = prog.relax(src_vals, w)
-        ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(src_front, cand, ident)
+        if self._xplan is not None:
+            v_loc = state.values[0]
+            f_loc = state.frontier[0]
+            own = jax.lax.axis_index(PARTS_AXIS)
+            base = own * max_nv
+            local = (sidx >= base) & (sidx < base + max_nv)
+            lidx = jnp.clip(sidx - base, 0, max_nv - 1)
+            cand_l = prog.relax(v_loc[lidx], w)
+            cand_r = prog.relax(all_v[sidx], w)
+            ident = identity_for(prog.combiner, cand_l.dtype)
+            cand_l = jnp.where(f_loc[lidx], cand_l, ident)
+            cand_r = jnp.where(all_f[sidx], cand_r, ident)
+            cand = jnp.where(local, cand_l, cand_r)
+            src_front = jnp.where(local, f_loc[lidx], all_f[sidx])
+        else:
+            src_vals = all_v[sidx]
+            src_front = all_f[sidx]
+            cand = prog.relax(src_vals, w)
+            ident = identity_for(prog.combiner, cand.dtype)
+            cand = jnp.where(src_front, cand, ident)
         acc = segment_reduce(
             cand, dg["dst_local"][0], num_segments=max_nv + 1,
             kind=prog.combiner,
@@ -1122,7 +1177,7 @@ class ShardedPushExecutor:
         """One dense iteration on this shard's (1, ...) blocks; returns the
         new blocks and the *local* new-frontier count."""
         loaded = self._dense_load(state, dg)
-        acc, _ = self._dense_comp(loaded, dg)
+        acc, _ = self._dense_comp(loaded, dg, state=state)
         return self._merge_update(state, acc, dg)
 
     # Sparse-iteration phases (same load/comp/update split).
@@ -1299,6 +1354,41 @@ class ShardedPushExecutor:
             return jax.jit(mapped)
 
         n_loaded = 1 if self.blocked_dense else 2
+        compact = self._xplan is not None
+        if compact:
+            # Compact flat tables are per-shard scatters, not the full
+            # path's replicated all_gather output; and comp needs the
+            # state for the local-first branch.
+            d_load = sm(
+                lambda st, dg: tuple(
+                    a[None] for a in self._dense_load(st, dg)
+                ),
+                (state_spec, specs),
+                tuple(P(PARTS_AXIS) for _ in range(n_loaded)),
+            )
+            d_comp = sm(
+                lambda st, loaded, dg: tuple(
+                    a[None] for a in self._dense_comp(
+                        tuple(x[0] for x in loaded), dg, state=st
+                    )
+                ),
+                (state_spec,
+                 tuple(P(PARTS_AXIS) for _ in range(n_loaded)), specs),
+                (P(PARTS_AXIS), P(PARTS_AXIS)),
+            )
+        else:
+            d_load = sm(
+                lambda st, dg: self._dense_load(st, dg),
+                (state_spec, specs),
+                tuple(P() for _ in range(n_loaded)),
+            )
+            d_comp = sm(
+                lambda loaded, dg: tuple(
+                    a[None] for a in self._dense_comp(loaded, dg)
+                ),
+                (tuple(P() for _ in range(n_loaded)), specs),
+                (P(PARTS_AXIS), P(PARTS_AXIS)),
+            )
         j = {
             "decide": sm(
                 lambda st, dg: tuple(
@@ -1306,18 +1396,8 @@ class ShardedPushExecutor:
                 ),
                 (state_spec, specs), (P(PARTS_AXIS), P(PARTS_AXIS)),
             ),
-            "d_load": sm(
-                lambda st, dg: self._dense_load(st, dg),
-                (state_spec, specs),
-                tuple(P() for _ in range(n_loaded)),
-            ),
-            "d_comp": sm(
-                lambda loaded, dg: tuple(
-                    a[None] for a in self._dense_comp(loaded, dg)
-                ),
-                (tuple(P() for _ in range(n_loaded)), specs),
-                (P(PARTS_AXIS), P(PARTS_AXIS)),
-            ),
+            "d_load": d_load,
+            "d_comp": d_comp,
             "update": sm(
                 lambda st, acc, dg: (
                     lambda r: (r[0], r[1][None])
@@ -1388,7 +1468,10 @@ class ShardedPushExecutor:
                 loaded = hard_sync(j["d_load"](state, dg))
             times["loadTime"] = t.elapsed
             with Timer() as t:
-                acc, edges = hard_sync(j["d_comp"](loaded, dg))
+                if self._xplan is not None:
+                    acc, edges = hard_sync(j["d_comp"](state, loaded, dg))
+                else:
+                    acc, edges = hard_sync(j["d_comp"](loaded, dg))
             times["compTime"] = t.elapsed
             with Timer() as t:
                 new_state, cnt = hard_sync(j["update"](state, acc, dg))
@@ -1414,7 +1497,10 @@ class ShardedPushExecutor:
         dg = self._dg
         jax.device_get(j["decide"](state, dg))
         loaded = j["d_load"](state, dg)
-        acc, _ = j["d_comp"](loaded, dg)
+        if self._xplan is not None:
+            acc, _ = j["d_comp"](state, loaded, dg)
+        else:
+            acc, _ = j["d_comp"](loaded, dg)
         hard_sync(j["update"](state, acc, dg))
         if self.sparse:
             for i in range(len(self.tiers)):
@@ -1437,10 +1523,17 @@ class ShardedPushExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            compact = self._xplan is not None
             rec.set_exchange_bytes(
-                self.exchange_bytes_per_iter(), note="dense_estimate",
+                self.exchange_bytes_per_iter(),
+                note="compact_all_to_all" if compact else "dense_estimate",
                 parts=self.num_parts)
-            useful = engobs.useful_exchange(self.sg, 5)
+            if compact:
+                rec.set_overlap(True)
+            useful = engobs.useful_exchange(
+                self.sg, 5,
+                exchanged_rows=(self._xplan.exchanged_units_per_iter
+                                if compact else None))
             if useful is not None:
                 rec.set_useful_bytes(useful["useful_bytes_per_iter"],
                                      useful["ratio"])
@@ -1481,8 +1574,12 @@ class ShardedPushExecutor:
         the P-1 others. The sparse branch moves less; per-branch
         accounting would need device readbacks the fixpoint loop doesn't
         do. This is the number PERF.md's serve_bench.v1 evidence policy
-        reports per device."""
+        reports per device. Compact mode reports the packed figure — the
+        fixed-capacity all_to_all payload that actually crosses the
+        interconnect (still a dense-branch bound; sparse moves less)."""
         p = self.num_parts
+        if self._xplan is not None:
+            return self._xplan.exchange_bytes_per_iter(5)
         return p * (p - 1) * self.sg.max_nv * 5
 
     def gather_values(self, state: PushState) -> np.ndarray:
@@ -1540,6 +1637,11 @@ class ShardedMultiSourcePushExecutor:
         }
         if self.sg.weights is not None:
             dg["weights"] = put(self.sg.weights)
+        self.exchange_mode, self._xplan = resolve_exchange(
+            self.sg, get_logger("engine"))
+        if self._xplan is not None:
+            dg["xch_send"] = put(self._xplan.send_units)
+            dg["xch_recv"] = put(self._xplan.recv_pos)
         self._dg = dg
         self._specs = {key: P(PARTS_AXIS) for key in dg}
         self.sparse_iters = 0   # API parity with the sharded push engine
@@ -1553,13 +1655,29 @@ class ShardedMultiSourcePushExecutor:
         self._step = jax.jit(mapped, donate_argnums=0)
         self._chunk_cache = {}
 
-    def _exchange_lanes_block(self, state: PushState):
+    def _exchange_lanes_block(self, state: PushState, dg):
         """Exchange bracket: all-gather the (values, frontier) shards
         into (P*max_nv, K) global tables. Split from the compute bracket
         so ``phase_step`` can fence the collective separately; the fused
-        ``_iter_block`` composes both, so the traced ops are identical."""
+        ``_iter_block`` composes both, so the traced ops are identical.
+        Compact mode moves only the needed rows — two fixed-capacity
+        all_to_alls of packed (capacity, K) slabs scattered into the flat
+        view; own-span and unread rows stay zero (frontier False), and
+        the compute bracket's local-first select never reads them."""
         v = state.values[0]                            # (max_nv, K)
         f = state.frontier[0]
+        if self._xplan is not None:
+            max_nv = self.sg.max_nv
+            sel = jnp.minimum(dg["xch_send"][0], max_nv - 1)
+            pv = jax.lax.all_to_all(
+                v[sel], PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True)
+            pf = jax.lax.all_to_all(
+                f[sel], PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True)
+            recv = dg["xch_recv"][0]
+            flat = self.num_parts * max_nv
+            all_v = jnp.zeros((flat + 1, self.k), v.dtype)
+            all_f = jnp.zeros((flat + 1, self.k), f.dtype)
+            return (all_v.at[recv].set(pv)[:-1], all_f.at[recv].set(pf)[:-1])
         all_v = jax.lax.all_gather(v, PARTS_AXIS).reshape(-1, self.k)
         all_f = jax.lax.all_gather(f, PARTS_AXIS).reshape(-1, self.k)
         return all_v, all_f
@@ -1570,12 +1688,31 @@ class ShardedMultiSourcePushExecutor:
         prog = self.program
         v = state.values[0]                            # (max_nv, K)
         sidx = dg["src_pidx"][0]
-        src_vals = all_v[sidx]                         # (max_ne, K)
-        src_front = all_f[sidx]
         w = dg["weights"][0] if "weights" in dg else None
-        cand = prog.relax(src_vals, None if w is None else w[:, None])
-        ident = identity_for(prog.combiner, cand.dtype)
-        cand = jnp.where(src_front, cand, ident)
+        wk = None if w is None else w[:, None]
+        if self._xplan is not None:
+            # Local-first overlap: the local branch relaxes against the
+            # shard's own lanes (no collective dependence), the remote
+            # branch against the scattered table; the per-edge select
+            # runs before the unchanged reduction, so the combine order
+            # — and the results — stay bitwise identical to full.
+            f_loc = state.frontier[0]
+            own = jax.lax.axis_index(PARTS_AXIS)
+            base = own * self.sg.max_nv
+            local = (sidx >= base) & (sidx < base + self.sg.max_nv)
+            lidx = jnp.clip(sidx - base, 0, self.sg.max_nv - 1)
+            cand_l = prog.relax(v[lidx], wk)
+            cand_r = prog.relax(all_v[sidx], wk)
+            ident = identity_for(prog.combiner, cand_l.dtype)
+            cand_l = jnp.where(f_loc[lidx], cand_l, ident)
+            cand_r = jnp.where(all_f[sidx], cand_r, ident)
+            cand = jnp.where(local[:, None], cand_l, cand_r)
+        else:
+            src_vals = all_v[sidx]                     # (max_ne, K)
+            src_front = all_f[sidx]
+            cand = prog.relax(src_vals, wk)
+            ident = identity_for(prog.combiner, cand.dtype)
+            cand = jnp.where(src_front, cand, ident)
         # Pad edges carry dst_local == max_nv: they land in the dropped
         # trash segment for every lane, so no edge mask is needed here
         # (same trick as the sharded single-source dense branch).
@@ -1599,7 +1736,7 @@ class ShardedMultiSourcePushExecutor:
         """One dense K-lane iteration on this shard's (1, max_nv, K)
         blocks; returns the new blocks and the local new-frontier count
         (summed over lanes)."""
-        all_v, all_f = self._exchange_lanes_block(state)
+        all_v, all_f = self._exchange_lanes_block(state, dg)
         return self._compute_lanes_block(state, all_v, all_f, dg)
 
     def _shard_step(self, state: PushState, dg):
@@ -1680,10 +1817,30 @@ class ShardedMultiSourcePushExecutor:
                 out_specs=out_specs, check_vma=False,
             ))
 
+        if self._xplan is not None:
+            # Per-shard scattered tables, not the replicated all_gather
+            # output: carry them shard-major between the two jits.
+            self._pjits = {
+                "exchange": sm(
+                    lambda st, dg: tuple(
+                        a[None] for a in self._exchange_lanes_block(st, dg)
+                    ),
+                    (state_spec, self._specs),
+                    (P(PARTS_AXIS), P(PARTS_AXIS)),
+                ),
+                "compute": sm(
+                    lambda st, av, af, dg: (
+                        lambda ns, cnt: (ns, cnt[None])
+                    )(*self._compute_lanes_block(st, av[0], af[0], dg)),
+                    (state_spec, P(PARTS_AXIS), P(PARTS_AXIS), self._specs),
+                    (state_spec, P(PARTS_AXIS)),
+                ),
+            }
+            return self._pjits
         self._pjits = {
             "exchange": sm(
-                lambda st: self._exchange_lanes_block(st),
-                (state_spec,), (P(), P()),
+                lambda st, dg: self._exchange_lanes_block(st, dg),
+                (state_spec, self._specs), (P(), P()),
             ),
             "compute": sm(
                 lambda st, av, af, dg: (
@@ -1703,7 +1860,7 @@ class ShardedMultiSourcePushExecutor:
         j = self._phase_jits()
         times = {}
         with Timer() as t:
-            all_v, all_f = hard_sync(j["exchange"](state))
+            all_v, all_f = hard_sync(j["exchange"](state, self._dg))
         times["loadTime"] = t.elapsed
         with Timer() as t:
             new_state, cnt = hard_sync(
@@ -1718,7 +1875,7 @@ class ShardedMultiSourcePushExecutor:
         """Compile both phase executables outside any timed region
         (``state`` is read, never donated)."""
         j = self._phase_jits()
-        all_v, all_f = j["exchange"](state)
+        all_v, all_f = j["exchange"](state, self._dg)
         hard_sync(j["compute"](state, all_v, all_f, self._dg))
 
     def run(
@@ -1741,10 +1898,17 @@ class ShardedMultiSourcePushExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            compact = self._xplan is not None
             rec.set_exchange_bytes(
-                self.exchange_bytes_per_iter(), note="dense_estimate",
+                self.exchange_bytes_per_iter(),
+                note="compact_all_to_all" if compact else "dense_estimate",
                 parts=self.num_parts)
-            useful = engobs.useful_exchange(self.sg, 5 * self.k)
+            if compact:
+                rec.set_overlap(True)
+            useful = engobs.useful_exchange(
+                self.sg, 5 * self.k,
+                exchanged_rows=(self._xplan.exchanged_units_per_iter
+                                if compact else None))
             if useful is not None:
                 rec.set_useful_bytes(useful["useful_bytes_per_iter"],
                                      useful["ratio"])
@@ -1784,10 +1948,14 @@ class ShardedMultiSourcePushExecutor:
         }
 
     def exchange_bytes_per_iter(self) -> int:
-        """Per-iteration exchange upper bound: the K-lane candidate
+        """Per-iteration exchange figure. Full: the K-lane candidate
         table broadcast — (max_nv values @4B + 1B flag) x K lanes from
-        each part to the P-1 others."""
+        each part to the P-1 others (a dense estimate). Compact: the
+        measured packed payload the fixed-capacity all_to_alls move,
+        K lanes x 5 bytes per exchanged row."""
         p = self.num_parts
+        if self._xplan is not None:
+            return self._xplan.exchange_bytes_per_iter(5 * self.k)
         return p * (p - 1) * self.sg.max_nv * self.k * 5
 
     def gather_values(self, state: PushState) -> np.ndarray:

@@ -48,6 +48,7 @@ from lux_tpu.engine.pull import (
 )
 from lux_tpu.engine.tiled import require_spmv_program
 from lux_tpu.graph.graph import Graph
+from lux_tpu.graph.partition import ExchangePlan
 from lux_tpu.obs import (
     consume_compile_seconds,
     engobs,
@@ -75,6 +76,8 @@ from lux_tpu.ops.tiled_spmv import (
     zstream_boundaries,
 )
 from lux_tpu.parallel.mesh import PARTS_AXIS, make_mesh, parts_sharding
+from lux_tpu.parallel.shard import exchange_mode
+from lux_tpu.utils.logging import get_logger
 
 
 # ---------------------------------------------------------------------------
@@ -463,6 +466,37 @@ class ShardedTiledExecutor:
             B = part.blocks[p]
             rank_in_owner[B] = np.arange(B.shape[0], dtype=np.int64)
             stack[p * max_nvb : p * max_nvb + B.shape[0]] = B
+
+        # Compact-exchange plan (LUX_EXCHANGE=compact): block-granular —
+        # a 128-row block is the finest unit the tiled gather addresses,
+        # so the needed-units lists are the ranks (within each owner's
+        # stacked layout) of the blocks each part's strips/tail read.
+        self._xplan = None
+        if exchange_mode() == "compact" and pcount > 1:
+            needs = [[np.zeros(0, np.int64)] * pcount for _ in range(pcount)]
+            for q in range(pcount):
+                blocks = np.fromiter(
+                    read_blocks[q], np.int64, len(read_blocks[q]))
+                owners_b = part.owner[blocks]
+                ranks = rank_in_owner[blocks]
+                for p in range(pcount):
+                    needs[q][p] = np.sort(ranks[owners_b == p])
+            # multiple=1: a unit is already a 128-row block, so there is
+            # no lane-alignment reason to round the capacity up (the
+            # default 8-unit rounding would sink profitability on small
+            # meshes where max_nvb is itself single digits).
+            xplan = ExchangePlan.from_needs(
+                needs, max_nvb, pcount, unit_rows=BLOCK, multiple=1)
+            if xplan.profitable:
+                self._xplan = xplan
+                self._shard_args["xch_send"] = put(xplan.send_units)
+                self._shard_args["xch_recv"] = put(xplan.recv_pos)
+            else:
+                get_logger("engine").info(
+                    "LUX_EXCHANGE=compact unprofitable for this tiled "
+                    "plan (capacity %d >= %d blocks/part); "
+                    "using the full exchange", xplan.capacity, max_nvb)
+        self.exchange_mode = "compact" if self._xplan is not None else "full"
         repl = jax.sharding.NamedSharding(self.mesh, P())
         self._replicated = {
             "block_map": jax.device_put(
@@ -477,12 +511,29 @@ class ShardedTiledExecutor:
 
     # -- per-shard step (runs under shard_map) ---------------------------
 
-    def _exchange_block(self, vals_blk, repl):
-        """Value exchange: all-gather the shards and rearrange into the
-        global (nvb, 128) gather operand."""
+    def _exchange_block(self, vals_blk, dg, repl):
+        """Value exchange into the global (nvb, 128) gather operand.
+        Full: all-gather the shards and rearrange via block_map. Compact:
+        fixed-capacity all_to_all of the packed needed blocks, scattered
+        into the owner-stacked view, own span written from the local
+        shard. Blocks this part neither owns nor reads stay zero — the
+        strips and tail never gather their columns (their block ids
+        appear in no cols/tail_sb entry), and pad strip slots multiply
+        them by all-zero coefficients, so the zeros never reach a sum."""
         v = vals_blk[0]                                   # (max_nv,) f32
-        gathered = jax.lax.all_gather(v, PARTS_AXIS)      # (P, max_nv)
-        return gathered.reshape(-1, BLOCK)[repl["block_map"]]  # (nvb, 128)
+        if self._xplan is None:
+            gathered = jax.lax.all_gather(v, PARTS_AXIS)  # (P, max_nv)
+            return gathered.reshape(-1, BLOCK)[repl["block_map"]]
+        max_nvb = self.part.max_nvb
+        v2d = v.reshape(max_nvb, BLOCK)
+        sel = jnp.minimum(dg["xch_send"][0], max_nvb - 1)
+        got = jax.lax.all_to_all(
+            v2d[sel], PARTS_AXIS, split_axis=0, concat_axis=0, tiled=True)
+        buf = jnp.zeros((self.num_parts * max_nvb + 1, BLOCK), v.dtype)
+        buf = buf.at[dg["xch_recv"][0]].set(got)
+        own = jax.lax.axis_index(PARTS_AXIS)
+        buf = jax.lax.dynamic_update_slice(buf, v2d, (own * max_nvb, 0))
+        return buf[:-1][repl["block_map"]]                # (nvb, 128)
 
     def _strips_block(self, x2d, dg, repl):
         """Strips: each shard sums ITS strips into a full-height partial
@@ -535,7 +586,7 @@ class ShardedTiledExecutor:
         return new[None]
 
     def _shard_step(self, vals_blk, dg, repl):
-        x2d = self._exchange_block(vals_blk, repl)
+        x2d = self._exchange_block(vals_blk, dg, repl)
         acc = self._strips_block(x2d, dg, repl)
         acc = acc + self._tail_block(x2d, dg)
         return self._apply_block(vals_blk, acc, dg)
@@ -577,19 +628,41 @@ class ShardedTiledExecutor:
                     out_specs=out_specs, check_vma=False,
                 ))
 
-            self._pjits = {
-                "exchange": sm(
-                    lambda v, repl: self._exchange_block(v, repl),
-                    (P(PARTS_AXIS), P()), P(),
-                ),
-                "strips": sm(
+            if self._xplan is not None:
+                # Compact operands are per-shard scatters (each part's
+                # unread blocks differ), not the replicated all_gather
+                # output: carry them shard-major between phase jits.
+                exchange = sm(
+                    lambda v, dg, repl: self._exchange_block(
+                        v, dg, repl)[None],
+                    (P(PARTS_AXIS), specs, P()), P(PARTS_AXIS),
+                )
+                strips = sm(
+                    lambda x, dg, repl: self._strips_block(
+                        x[0], dg, repl)[None],
+                    (P(PARTS_AXIS), specs, P()), P(PARTS_AXIS),
+                )
+                tail = sm(
+                    lambda x, dg: self._tail_block(x[0], dg)[None],
+                    (P(PARTS_AXIS), specs), P(PARTS_AXIS),
+                )
+            else:
+                exchange = sm(
+                    lambda v, dg, repl: self._exchange_block(v, dg, repl),
+                    (P(PARTS_AXIS), specs, P()), P(),
+                )
+                strips = sm(
                     lambda x, dg, repl: self._strips_block(x, dg, repl)[None],
                     (P(), specs, P()), P(PARTS_AXIS),
-                ),
-                "tail": sm(
+                )
+                tail = sm(
                     lambda x, dg: self._tail_block(x, dg)[None],
                     (P(), specs), P(PARTS_AXIS),
-                ),
+                )
+            self._pjits = {
+                "exchange": exchange,
+                "strips": strips,
+                "tail": tail,
                 "apply": sm(
                     lambda v, a, b, dg: self._apply_block(
                         v, a[0] + b[0], dg
@@ -601,7 +674,7 @@ class ShardedTiledExecutor:
         j, times = self._pjits, {}
         dg, repl = self._shard_args, self._replicated
         with Timer() as t:
-            x2d = hard_sync(j["exchange"](vals, repl))
+            x2d = hard_sync(j["exchange"](vals, dg, repl))
         times["exchange"] = t.elapsed
         with Timer() as t:
             acc_s = hard_sync(j["strips"](x2d, dg, repl))
@@ -634,8 +707,11 @@ class ShardedTiledExecutor:
         }
 
     def _exchange_bytes_per_iter(self, vals) -> int:
-        """ICI bytes for one iteration's all-gather of the (P, max_nv)
-        value stack: each part sends its shard to the P-1 others."""
+        """ICI bytes for one iteration's exchange. Full: all-gather of
+        the (P, max_nv) value stack — each part sends its shard to the
+        P-1 others. Compact: the packed block all_to_all payload."""
+        if self._xplan is not None:
+            return self._xplan.exchange_bytes_per_iter(vals.dtype.itemsize)
         shard_elems = int(np.prod(vals.shape[1:])) if vals.ndim > 1 else 1
         p = self.num_parts
         return p * (p - 1) * shard_elems * vals.dtype.itemsize
@@ -649,13 +725,19 @@ class ShardedTiledExecutor:
         rec.start()
         if rec.enabled:
             rec.record_compile(consume_compile_seconds(self))
+            compact = self._xplan is not None
             rec.set_exchange_bytes(
-                self._exchange_bytes_per_iter(vals), note="all_gather",
+                self._exchange_bytes_per_iter(vals),
+                note="compact_all_to_all" if compact else "all_gather",
                 parts=self.num_parts)
             counts = getattr(self, "_remote_read_counts", None)
             if counts is not None:
                 p = self.num_parts
-                exchanged = p * (p - 1) * self.max_nv
+                if compact:
+                    exchanged = (self._xplan.exchanged_units_per_iter
+                                 * self._xplan.unit_rows)
+                else:
+                    exchanged = p * (p - 1) * self.max_nv
                 useful_rows = int(counts.sum() - np.trace(counts))
                 if exchanged:
                     rec.set_useful_bytes(

@@ -154,6 +154,63 @@ def small_world(
     )
 
 
+def halo(
+    blocks: int,
+    span: int,
+    hubs: int = 16,
+    seed: int = 0,
+    weighted: bool = False,
+) -> Graph:
+    """Halo-exchange locality graph: ``blocks`` contiguous ranges of
+    ``span`` vertices, a forward chain inside each range, and exactly
+    ``hubs`` cross-range source rows read by every other range — the
+    stencil/halo communication pattern where each partition's remote
+    reads are a small fixed set of boundary rows.
+
+    Per-range edge totals are identical, so an edge-balanced contiguous
+    P-way partition with ``P == blocks`` recovers the ranges to within a
+    few boundary rows, and every part reads the same ``hubs`` mid-range
+    rows from every other part (mid-range placement keeps hub ownership
+    immune to the small boundary drift of the strictly-exceeds split
+    rule): the best case for the compacted exchange — per-pair needs are
+    uniform, so the fixed all_to_all capacity carries no padding
+    waste."""
+    if span // 2 + (blocks - 1) * hubs > span:
+        raise ValueError(
+            f"span {span} too small for {(blocks - 1) * hubs} distinct "
+            "mid-range cross destinations"
+        )
+    mid = span // 2
+    src = []
+    dst = []
+    for b in range(blocks):
+        base = b * span
+        # Forward chain keeps every range internally connected with
+        # purely local edges (the compute the overlap path hides).
+        chain = np.arange(span - 1, dtype=np.int64) + base
+        src.append(chain)
+        dst.append(chain + 1)
+    for q in range(blocks):
+        for p in range(blocks):
+            if p == q:
+                continue
+            # Sender p's ``hubs`` mid-range rows land on distinct
+            # receiver rows (one slot group per sender), so in-degrees
+            # stay even and the per-pair needed-rows count is exactly
+            # ``hubs`` plus the adjacent chain-boundary row.
+            t = (p - q - 1) % blocks
+            j = np.arange(hubs, dtype=np.int64)
+            src.append(p * span + mid + j)
+            dst.append(q * span + mid + t * hubs + j)
+    src = np.concatenate(src)
+    dst = np.concatenate(dst)
+    w = None
+    if weighted:
+        rng = np.random.default_rng(seed)
+        w = rng.integers(1, 101, size=src.size, dtype=np.int32)
+    return Graph.from_edges(src, dst, nv=blocks * span, weights=w)
+
+
 def bipartite_ratings(
     n_users: int,
     n_items: int,

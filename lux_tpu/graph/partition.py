@@ -23,7 +23,7 @@ Two deliberate divergences:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -98,3 +98,140 @@ class PartitionInfo:
     @property
     def max_part_ne(self) -> int:
         return max((e - s) for (s, e) in self.edge_bounds) if self.edge_bounds else 0
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclasses.dataclass(eq=False)
+class ExchangePlan:
+    """Precomputed needed-rows exchange tables for the sharded engines.
+
+    The full exchange all-gathers every part's whole ``max_units``-row
+    shard to every other part; the remote-read index proves most of
+    those rows are never gathered by the receiver. This plan turns the
+    exchange into a fixed-capacity ``all_to_all`` of packed rows: per
+    (sender p → receiver q) pair, ``send_units[p]`` lists exactly the
+    local row ids of p that q's real edges read, padded to one static
+    ``capacity`` so shapes never change across iterations (the
+    zero-recompile contract), and ``recv_pos[q]`` scatters the received
+    rows into q's flat ``(P * max_units,)`` view at the positions the
+    unchanged compute bodies index. ``unit_rows`` generalizes the unit:
+    1 for row-granular plans (ShardedGraph), BLOCK for the tiled
+    executor's 128-row block granularity.
+
+    Sentinels: a pad entry of ``send_units`` is ``max_units`` (senders
+    clip the gather; the row's payload is garbage) and the matching
+    ``recv_pos`` entry is ``P * max_units`` (receivers scatter it into a
+    trash row sliced off before compute), so pad traffic can never leak
+    into results.
+    """
+
+    num_parts: int
+    max_units: int          # per-part padded unit count (max_nv / max_nvb)
+    unit_rows: int          # value rows per unit (1, or BLOCK for tiled)
+    capacity: int           # static per-(sender, receiver) unit capacity
+    counts: np.ndarray      # (P, P) int64: units part q reads of part p
+    send_units: np.ndarray  # (P, P*capacity) int32 sender gather lists
+    recv_pos: np.ndarray    # (P, P*capacity) int32 receiver scatter slots
+
+    @property
+    def exchanged_units_per_iter(self) -> int:
+        """Units moved per iteration over the whole mesh (capacity
+        figure — what actually crosses the interconnect)."""
+        p = self.num_parts
+        return p * (p - 1) * self.capacity
+
+    def exchange_bytes_per_iter(self, row_bytes: int) -> int:
+        """Interconnect bytes per iteration for ``row_bytes`` per value
+        row — the packed-capacity figure the exchange ledger prices."""
+        return self.exchanged_units_per_iter * self.unit_rows * int(row_bytes)
+
+    @property
+    def profitable(self) -> bool:
+        """Whether the packed exchange moves strictly fewer rows per
+        pair than the full all-gather; executors fall back to the full
+        path (with a log note) when this is False."""
+        return self.capacity < self.max_units
+
+    @staticmethod
+    def from_needs(
+        needs,
+        max_units: int,
+        num_parts: int,
+        unit_rows: int = 1,
+        multiple: int = 8,
+        capacity: Optional[int] = None,
+    ) -> "ExchangePlan":
+        """Build from per-(receiver, sender) needed-unit lists.
+
+        ``needs[q][p]`` is an ascending int array of the LOCAL unit ids
+        of part p that part q reads (``needs[q][q]`` counts toward the
+        ledger's diagonal but is never exchanged — own rows stay local).
+        ``capacity`` pins the static per-pair pad width; when the needed
+        rows of any pair exceed it, the build fails loudly (silent
+        truncation would silently corrupt results downstream)."""
+        P = num_parts
+        counts = np.zeros((P, P), dtype=np.int64)
+        for q in range(P):
+            for p in range(P):
+                counts[q, p] = len(needs[q][p])
+        off_diag = counts - np.diag(np.diag(counts))
+        required = int(off_diag.max()) if P > 1 else 0
+        cap = _round_up(max(required, 1), multiple)
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < required:
+                raise ValueError(
+                    f"exchange capacity {capacity} cannot hold the "
+                    f"{required} needed units of the densest "
+                    "(sender, receiver) pair — refusing to truncate "
+                    "the exchange"
+                )
+            cap = max(capacity, 1)
+        send = np.full((P, P, cap), max_units, dtype=np.int32)
+        recv = np.full((P, P, cap), P * max_units, dtype=np.int32)
+        for q in range(P):
+            for p in range(P):
+                if p == q:
+                    continue
+                rows = np.asarray(needs[q][p], dtype=np.int64)
+                n = rows.shape[0]
+                if n:
+                    send[p, q, :n] = rows.astype(np.int32)
+                    recv[q, p, :n] = (p * max_units + rows).astype(np.int32)
+        return ExchangePlan(
+            num_parts=P,
+            max_units=max_units,
+            unit_rows=int(unit_rows),
+            capacity=cap,
+            counts=counts,
+            send_units=send.reshape(P, P * cap),
+            recv_pos=recv.reshape(P, P * cap),
+        )
+
+    @staticmethod
+    def from_src_pidx(
+        src_pidx: np.ndarray,
+        edge_mask: np.ndarray,
+        max_nv: int,
+        num_parts: int,
+        multiple: int = 8,
+        capacity: Optional[int] = None,
+    ) -> "ExchangePlan":
+        """Row-granular plan from the stacked flat-index edge arrays —
+        the same ``src_pidx``/``edge_mask`` data that feeds
+        ``ShardedGraph.remote_read_counts``, so the plan's ``counts``
+        matrix is identical to the ledger's remote-read index."""
+        P = num_parts
+        needs = [[np.zeros(0, np.int64)] * P for _ in range(P)]
+        for q in range(P):
+            rows = np.unique(src_pidx[q][edge_mask[q]]).astype(np.int64)
+            owners = rows // max_nv
+            for p in range(P):
+                needs[q][p] = rows[owners == p] - p * max_nv
+        return ExchangePlan.from_needs(
+            needs, max_nv, P, unit_rows=1, multiple=multiple,
+            capacity=capacity,
+        )

@@ -72,20 +72,26 @@ def reset():
 # -- exchange ledger -------------------------------------------------------
 
 
-def useful_exchange(sg, row_bytes: int) -> Optional[dict]:
-    """Price one iteration's all_gather against the remote-read index.
+def useful_exchange(sg, row_bytes: int,
+                    exchanged_rows: Optional[int] = None) -> Optional[dict]:
+    """Price one iteration's exchange against the remote-read index.
 
-    Every part broadcasts its full ``max_nv``-row shard to the P-1
-    others; only the rows some receiver's local edges actually index are
-    useful. Returns ``{useful_rows, exchanged_rows, useful_bytes_per_iter,
-    ratio}`` or None when the plan's edge arrays were already released
-    (ShardedGraph.release_edge_arrays) and the index was never built.
+    The full path broadcasts each part's whole ``max_nv``-row shard to
+    the P-1 others; only the rows some receiver's local edges actually
+    index are useful. Pass ``exchanged_rows`` to price a compacted
+    exchange instead (the packed-capacity row count that actually
+    crosses the interconnect). Returns ``{useful_rows, exchanged_rows,
+    useful_bytes_per_iter, ratio}`` or None when the plan's edge arrays
+    were already released (ShardedGraph.release_edge_arrays) and the
+    index was never built.
     """
     counts = sg.remote_read_counts()
     if counts is None:
         return None
     p = sg.num_parts
-    exchanged_rows = p * (p - 1) * sg.max_nv
+    if exchanged_rows is None:
+        exchanged_rows = p * (p - 1) * sg.max_nv
+    exchanged_rows = int(exchanged_rows)
     # Off-diagonal entries only: a part's reads of its own rows never
     # cross the interconnect.
     useful_rows = int(counts.sum() - counts.trace())

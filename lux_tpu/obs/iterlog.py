@@ -58,6 +58,9 @@ class _NullRecorder:
     def set_exchange_bytes(self, per_iter, note=None, parts=None):
         pass
 
+    def set_overlap(self, enabled):
+        pass
+
     def set_useful_bytes(self, per_iter, ratio, note=None):
         pass
 
@@ -143,6 +146,7 @@ class IterationRecorder:
         self.useful_bytes_per_iter = None
         self.useful_ratio = None
         self.hbm_bytes_per_iter = None
+        self.overlap = False
         self.phase_s = {"exchange": 0.0, "compute": 0.0}
         self.crossovers = []
         self.iterations = []
@@ -202,6 +206,17 @@ class IterationRecorder:
         if parts is not None:
             self.parts = int(parts)
         self._m_exch_per_iter.set(per_iter)
+
+    def set_overlap(self, enabled):
+        """Mark the run's exchange as compute-overlapped (the compact
+        path issues the collective before the local-edge contribution,
+        letting XLA hide one under the other). Phase-fenced runs then
+        report ``exchange_hidden_frac`` — the fraction of measured
+        exchange wall that concurrent compute could cover,
+        ``min(exchange_s, compute_s) / exchange_s``. The fenced split
+        serializes the phases, so this is the overlap *budget* the fused
+        program can exploit, not a direct measurement of it."""
+        self.overlap = bool(enabled)
 
     def set_useful_bytes(self, per_iter, ratio, note=None):
         """Exchange-ledger useful-bytes: of ``exchange_bytes_per_iter``,
@@ -272,6 +287,10 @@ class IterationRecorder:
             "compute_s": compute_s,
             "exchange_frac": exchange_s / phased if phased > 0 else 0.0,
         }
+        if self.overlap:
+            rec["exchange_hidden_frac"] = (
+                min(exchange_s, compute_s) / exchange_s
+                if exchange_s > 0 else 1.0)
         self._branch_into(rec, branch, frontier)
         if detail:
             rec["phase_detail"] = {
@@ -394,6 +413,11 @@ class IterationRecorder:
                 "exchange_frac": (self.phase_s["exchange"] / phased
                                   if phased > 0 else 0.0),
             }
+            if self.overlap:
+                ex_s = self.phase_s["exchange"]
+                out["phases"]["exchange_hidden_frac"] = (
+                    min(ex_s, self.phase_s["compute"]) / ex_s
+                    if ex_s > 0 else 1.0)
         if self.useful_bytes_per_iter is not None:
             out["useful_bytes_per_iter"] = self.useful_bytes_per_iter
             out["useful_ratio"] = self.useful_ratio
@@ -418,6 +442,8 @@ class IterationRecorder:
             engobs.note(self.engine, run_exchange_s=self.phase_s["exchange"],
                         run_compute_s=self.phase_s["compute"],
                         run_exchange_frac=summary["phases"]["exchange_frac"],
+                        run_exchange_hidden_frac=summary["phases"].get(
+                            "exchange_hidden_frac"),
                         num_iters=self._iters)
         from . import report
         report.finalize(summary)

@@ -26,7 +26,51 @@ from typing import Optional
 import numpy as np
 
 from lux_tpu.graph.graph import Graph
-from lux_tpu.graph.partition import PartitionInfo
+from lux_tpu.graph.partition import ExchangePlan, PartitionInfo
+from lux_tpu.utils import flags
+
+
+def exchange_mode() -> str:
+    """The requested sharded exchange mode (``LUX_EXCHANGE``), validated.
+
+    Executors capture this at build time (jit traces once), so a flag
+    flip mid-process only affects engines built after it — the serving
+    pool keys carry the mode for exactly this reason."""
+    v = (flags.get("LUX_EXCHANGE") or "full").strip().lower()
+    if v not in ("full", "compact"):
+        raise ValueError(
+            f"LUX_EXCHANGE={v!r}: use 'full' (whole-shard all_gather) or "
+            "'compact' (needed-rows packed exchange)"
+        )
+    return v
+
+
+def resolve_exchange(sg: "ShardedGraph", log=None):
+    """(mode, plan) an executor should build with: the requested mode,
+    downgraded to ``("full", None)`` whenever compaction cannot help —
+    P=1 (compaction must be a no-op: the build emits the exact full-mode
+    program), released edge arrays (no plan can be derived), or an
+    unprofitable plan (densest pair needs >= max_nv rows, so packing
+    would move more than the all_gather). Downgrades are logged, never
+    silent."""
+    mode = exchange_mode()
+    if mode != "compact":
+        return "full", None
+    if sg.num_parts <= 1:
+        return "full", None
+    plan = sg.exchange_plan()
+    why = None
+    if plan is None:
+        why = "edge arrays were released before a plan was built"
+    elif not plan.profitable:
+        why = (f"capacity {plan.capacity} >= max_nv {sg.max_nv}: packing "
+               "would move more rows than the all_gather")
+        plan = None
+    if plan is None:
+        if log is not None:
+            log.info("LUX_EXCHANGE=compact falling back to full: %s", why)
+        return "full", None
+    return "compact", plan
 
 
 def _round_up(x: int, m: int) -> int:
@@ -202,6 +246,31 @@ class ShardedGraph:
                 ).astype(np.int64)
         self._remote_read_counts = counts
         return counts
+
+    def exchange_plan(self, capacity: Optional[int] = None):
+        """Row-granular :class:`ExchangePlan` for the compacted exchange
+        (``LUX_EXCHANGE=compact``): per-(sender → receiver) send-row
+        index tables derived from the same ``src_pidx``/``edge_mask``
+        data that feeds :meth:`remote_read_counts`, padded to one static
+        per-pair capacity.
+
+        Cached on the instance (default capacity only) like the
+        remote-read index; returns the cached plan after
+        ``release_edge_arrays``, or None when the arrays were released
+        before a plan was ever built. An explicit ``capacity`` too small
+        for the densest pair raises (loud, never truncating)."""
+        cached = getattr(self, "_exchange_plan", None)
+        if capacity is None and cached is not None:
+            return cached
+        if self.src_pidx is None or self.edge_mask is None:
+            return cached
+        plan = ExchangePlan.from_src_pidx(
+            self.src_pidx, self.edge_mask, self.max_nv, self.num_parts,
+            capacity=capacity,
+        )
+        if capacity is None:
+            self._exchange_plan = plan
+        return plan
 
     # -- push-direction (CSR-by-global-src) view -------------------------
 

@@ -181,8 +181,16 @@ class Session:
         # (program, fingerprint, batch width, mesh shape) tuple: a warm
         # sharded engine can never answer for a single-chip one (or for
         # a different mesh), and /statusz groups pool entries by it.
-        return ((kind, snap.fingerprint) + tuple(extra)
-                + (self.meshspec.shape,))
+        # Sharded keys also carry the exchange mode captured at build
+        # (LUX_EXCHANGE): a full-exchange engine warmed before a flag
+        # flip must not answer for compact (different executables, same
+        # results) — the pool warms a fresh entry instead.
+        key = (kind, snap.fingerprint) + tuple(extra)
+        if self.sharded:
+            from lux_tpu.parallel.shard import exchange_mode
+
+            key = key + (exchange_mode(),)
+        return key + (self.meshspec.shape,)
 
     @property
     def sharded(self) -> bool:

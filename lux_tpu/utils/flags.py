@@ -303,6 +303,16 @@ define("LUX_BREAKER_COOLDOWN_MS", 2000.0,
        "ms an open breaker waits before going half-open and probing the "
        "rebuilt engine in the background", kind="float")
 
+# Sharded-engine exchange path (parallel/shard.py, engine/pull_sharded.py,
+# engine/push.py, engine/tiled_sharded.py)
+define("LUX_EXCHANGE", "full",
+       "sharded-executor value exchange: 'full' all-gathers whole shard "
+       "tables every iteration; 'compact' sends only the rows some "
+       "receiving part actually reads (fixed-capacity all_to_all of "
+       "packed rows + receiver scatter, bitwise-equal results, "
+       "local-first overlap). Captured at executor build; P=1 and "
+       "unprofitable plans fall back to full")
+
 # Multi-chip serving (serve/mesh.py, serve/session.py)
 define("LUX_SERVE_MESH", 1,
        "serving device mesh spec: a device count ('8') or PxQ shape "

@@ -1,0 +1,181 @@
+"""Compacted needed-rows exchange: plan edge cases, full-vs-compact
+parity, and the P=1 passthrough (8-device virtual mesh via conftest)."""
+
+import numpy as np
+import pytest
+
+from lux_tpu.engine.pull_sharded import ShardedPullExecutor
+from lux_tpu.engine.push import (
+    ShardedMultiSourcePushExecutor,
+    ShardedPushExecutor,
+)
+from lux_tpu.graph import generate
+from lux_tpu.graph.partition import ExchangePlan
+from lux_tpu.models.pagerank import PageRank
+from lux_tpu.models.sssp import SSSP, reference_sssp
+from lux_tpu.parallel.mesh import make_mesh
+from lux_tpu.parallel.shard import ShardedGraph, resolve_exchange
+
+
+def _empty_needs(P):
+    return [[np.zeros(0, np.int64)] * P for _ in range(P)]
+
+
+# -- plan construction edge cases -----------------------------------------
+
+
+def test_plan_zero_remote_readers():
+    """No part reads anything remote: every table slot is a sentinel and
+    the (minimum-capacity) plan still beats the full all-gather."""
+    P, max_units = 4, 64
+    plan = ExchangePlan.from_needs(_empty_needs(P), max_units, P)
+    assert plan.counts.sum() == 0
+    assert plan.capacity == 8  # max(required=0, 1) rounded to the lane 8
+    assert plan.profitable
+    assert (plan.send_units == max_units).all()          # sender sentinel
+    assert (plan.recv_pos == P * max_units).all()        # trash-row slot
+    assert plan.exchanged_units_per_iter == P * (P - 1) * 8
+
+
+def test_plan_empty_parts():
+    """A part with no vertices neither sends nor receives: its counts
+    row and column stay zero and its table slots stay sentinels."""
+    P, max_units = 4, 16
+    needs = _empty_needs(P)
+    # Parts 0..2 each read rows [0, 1] of the next part; part 3 is empty.
+    for q in range(3):
+        needs[q][(q + 1) % 3] = np.array([0, 1], dtype=np.int64)
+    plan = ExchangePlan.from_needs(needs, max_units, P)
+    assert plan.counts[3].sum() == 0 and plan.counts[:, 3].sum() == 0
+    send = plan.send_units.reshape(P, P, plan.capacity)
+    recv = plan.recv_pos.reshape(P, P, plan.capacity)
+    assert (send[3] == max_units).all()
+    assert (recv[3] == P * max_units).all()
+    # The populated pair round-trips: sender rows scatter to the flat
+    # positions the compute bodies index.
+    np.testing.assert_array_equal(send[1, 0, :2], [0, 1])
+    np.testing.assert_array_equal(recv[0, 1, :2],
+                                  [1 * max_units, 1 * max_units + 1])
+
+
+def test_plan_all_remote_worst_case_unprofitable():
+    """Every part reads every row of every other part: capacity can't
+    beat max_units, so the plan is unprofitable and resolve_exchange
+    downgrades to the full path."""
+    P, max_units = 4, 16
+    needs = [[np.arange(max_units, dtype=np.int64)] * P for _ in range(P)]
+    plan = ExchangePlan.from_needs(needs, max_units, P)
+    assert plan.capacity >= max_units
+    assert not plan.profitable
+
+
+def test_resolve_falls_back_on_dense_graph(monkeypatch):
+    """gnp's uniform sources read ~every remote row: the resolved mode
+    must be full with no plan (and the executor must still build)."""
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    g = generate.gnp(400, 12000, seed=3)
+    sg = ShardedGraph.build(g, 8)
+    mode, plan = resolve_exchange(sg)
+    assert (mode, plan) == ("full", None)
+    ex = ShardedPushExecutor(g, SSSP(), mesh=make_mesh(8))
+    assert ex.exchange_mode == "full" and ex._xplan is None
+
+
+def test_plan_capacity_overflow_fails_loudly():
+    P, max_units = 4, 16
+    needs = _empty_needs(P)
+    needs[0][1] = np.arange(10, dtype=np.int64)
+    with pytest.raises(ValueError, match="refusing to truncate"):
+        ExchangePlan.from_needs(needs, max_units, P, capacity=4)
+    # An explicit capacity that does fit is honored un-rounded.
+    plan = ExchangePlan.from_needs(needs, max_units, P, capacity=11)
+    assert plan.capacity == 11
+
+
+def test_plan_counts_match_remote_read_counts():
+    """from_src_pidx prices with the exact matrix the exchange ledger
+    reads (remote_read_counts), so the two can never disagree."""
+    g = generate.halo(4, 128, hubs=8)
+    sg = ShardedGraph.build(g, 4)
+    plan = ExchangePlan.from_src_pidx(
+        sg.src_pidx, sg.edge_mask, sg.max_nv, 4)
+    np.testing.assert_array_equal(plan.counts, sg.remote_read_counts())
+
+
+# -- executor parity and passthrough ---------------------------------------
+
+
+def _run_both(monkeypatch, build, run):
+    out = {}
+    for mode in ("full", "compact"):
+        monkeypatch.setenv("LUX_EXCHANGE", mode)
+        ex = run_ex = build()
+        out[mode] = (ex, run(run_ex))
+    return out
+
+
+@pytest.mark.parametrize("app", ["sssp", "components"])
+def test_push_parity_full_vs_compact(monkeypatch, app):
+    from lux_tpu.models.components import ConnectedComponents
+
+    g = generate.halo(8, 128, hubs=8, weighted=True)
+    mesh = make_mesh(8)
+    prog, kw = ((SSSP(), {"start": 0}) if app == "sssp"
+                else (ConnectedComponents(), {}))
+    out = _run_both(
+        monkeypatch,
+        lambda: ShardedPushExecutor(g, prog, mesh=mesh),
+        lambda ex: ex.gather_values(ex.run(**kw)[0]),
+    )
+    assert out["compact"][0]._xplan is not None, "compact did not engage"
+    np.testing.assert_array_equal(out["full"][1], out["compact"][1])
+    # Compact must also price strictly below the full exchange.
+    assert (out["compact"][0].exchange_bytes_per_iter()
+            < out["full"][0].exchange_bytes_per_iter())
+
+
+def test_pull_parity_full_vs_compact(monkeypatch):
+    g = generate.halo(8, 128, hubs=8)
+    mesh = make_mesh(8)
+    out = _run_both(
+        monkeypatch,
+        lambda: ShardedPullExecutor(g, PageRank(), mesh=mesh),
+        lambda ex: ex.gather_values(ex.run(6, flush_every=0)),
+    )
+    assert out["compact"][0]._xplan is not None, "compact did not engage"
+    np.testing.assert_array_equal(out["full"][1], out["compact"][1])
+
+
+def test_multi_source_p1_passthrough(monkeypatch):
+    """P=1 under LUX_EXCHANGE=compact is a no-op: full mode, no plan, no
+    tables — and answers still match the host oracle."""
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    g = generate.gnp(300, 2400, seed=11, weighted=True)
+    roots = [0, 7, 55]
+    ex = ShardedMultiSourcePushExecutor(g, SSSP(), k=3, mesh=make_mesh(1))
+    assert ex.exchange_mode == "full" and ex._xplan is None
+    assert "xch_send" not in ex._dg
+    state, _ = ex.run(roots)
+    got = ex.gather_values(state)
+    for lane, r in enumerate(roots):
+        np.testing.assert_array_equal(got[:, lane], reference_sssp(g, r))
+
+
+def test_multi_source_compact_bytes_measured(monkeypatch):
+    """Satellite 2: the multi-source executor's exchange_bytes_per_iter
+    reports the measured packed figure when compact, not the dense
+    estimate."""
+    g = generate.halo(8, 128, hubs=8, weighted=True)
+    mesh = make_mesh(8)
+    out = _run_both(
+        monkeypatch,
+        lambda: ShardedMultiSourcePushExecutor(g, SSSP(), k=2, mesh=mesh),
+        lambda ex: ex.gather_values(ex.run([0, 300])[0]),
+    )
+    ex_c = out["compact"][0]
+    assert ex_c._xplan is not None, "compact did not engage"
+    np.testing.assert_array_equal(out["full"][1], out["compact"][1])
+    assert (ex_c.exchange_bytes_per_iter()
+            == ex_c._xplan.exchange_bytes_per_iter(5 * ex_c.k))
+    assert (ex_c.exchange_bytes_per_iter()
+            < out["full"][0].exchange_bytes_per_iter())

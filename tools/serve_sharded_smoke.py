@@ -178,6 +178,26 @@ def main() -> int:
     print(f"post-swap: {len(roots)} roots bit-identical to the host "
           "oracle on the merged graph")
 
+    # -- 4b: burst under the compacted exchange -------------------------
+    # Flipping LUX_EXCHANGE mid-process must build NEW engines (pool
+    # keys carry the mode) under expect windows, answer bit-identically,
+    # and keep the zero-recompile contract.
+    os.environ["LUX_EXCHANGE"] = "compact"
+    try:
+        with ThreadPoolExecutor(max_workers=4) as tp:
+            futs = [tp.submit(one, r) for r in burst_roots[:8]]
+            compact_burst = [f.result() for f in futs]
+        assert not errors, f"queries failed under compact: {errors}"
+        for r, _, out in compact_burst:
+            np.testing.assert_array_equal(
+                np.asarray(out["values"], np.uint32),
+                reference_sssp(new_g, r))
+    finally:
+        del os.environ["LUX_EXCHANGE"]
+    print(f"compact burst: {len(compact_burst)} LUX_EXCHANGE=compact "
+          "queries on freshly-keyed engines, each bit-identical to the "
+          "oracle")
+
     # -- 5+6: zero recompiles, mesh observability -----------------------
     stats, _ = get(base, "/stats")
     recompiles = stats["pool"]["recompiles"]
@@ -211,6 +231,7 @@ def main() -> int:
                  "plans_evicted": summary["plans_evicted"]},
         "in_flight": {"queries": len(burst), "failed": 0,
                       "answered_by_v0": n_v0},
+        "compact_burst": {"queries": len(compact_burst), "failed": 0},
         "recompiles": recompiles,
     }
     print("serve-sharded-smoke PASS (mesh-keyed pool, bitwise parity, "

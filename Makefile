@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -21,13 +21,19 @@ lint-ir:
 lint-threads:
 	python tools/luxlint.py --threads
 
+# Exchange tier: ExchangePlan structure/coverage/profitability proofs
+# plus the overlap, sentinel-annihilator, and byte-accounting dataflow
+# rules over every full+compact sharded registry target (LUX401-406).
+lint-exchange:
+	python tools/luxlint.py --exchange
+
 plan-check:
 	python tools/plan_check.py
 
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py

@@ -77,10 +77,19 @@ class TraceTarget:
     call: Callable                  # callable(*args) -> step outputs
     args: Tuple = ()                # example args (dynamic only)
     donate: Tuple[int, ...] = ()    # argnums donated by the real jit
-    carry: Tuple[int, ...] = (0,)   # argnums forming the iteration carry
+    carry: Tuple[int, ...] = (0,)   # argnums whose leaves are the carry
     sharded: bool = False           # collectives expected iff True
     lower: Optional[Callable] = None  # () -> jax.stages.Lowered
     axis_env: Tuple = ()            # [(name, size)] for axis-using fns
+    # Exchange-tier metadata (LUX404-406); plan-carrying sharded
+    # executors expose these in their trace dicts, everything else
+    # leaves the defaults and the LUX40x IR rules skip the target.
+    exchange_mode: str = ""         # "full" / "compact" ("" = not sharded)
+    exchange_bytes: Optional[int] = None  # exchange_bytes_per_iter claim
+    combiner: str = ""              # program combiner ("min"/"max"/"sum")
+    value_dtype: str = ""           # dtype of the exchanged value rows
+    num_parts: int = 0              # mesh parts the step is mapped over
+    plan: object = None             # the live ExchangePlan (compact only)
 
 
 def target_from_spec(name: str, spec: dict) -> TraceTarget:
@@ -93,6 +102,7 @@ def target_from_spec(name: str, spec: dict) -> TraceTarget:
     lower = spec.get("lower")
     if lower is None and hasattr(fn, "lower"):
         lower = lambda fn=fn, args=args: fn.lower(*args)  # noqa: E731
+    eb = spec.get("exchange_bytes")
     return TraceTarget(
         name=name, call=call, args=args,
         donate=tuple(spec.get("donate", ())),
@@ -100,6 +110,12 @@ def target_from_spec(name: str, spec: dict) -> TraceTarget:
         sharded=bool(spec.get("sharded", False)),
         lower=lower,
         axis_env=tuple(spec.get("axis_env", ())),
+        exchange_mode=str(spec.get("exchange_mode", "")),
+        exchange_bytes=None if eb is None else int(eb),
+        combiner=str(spec.get("combiner", "")),
+        value_dtype=str(spec.get("value_dtype", "")),
+        num_parts=int(spec.get("num_parts", 0)),
+        plan=spec.get("plan"),
     )
 
 
@@ -412,7 +428,512 @@ def all_ir_rules() -> List[IRRule]:
     ]
 
 
+# -- the exchange tier: collective-dataflow rules (LUX404-406) ----------
+#
+# The IR half of ``luxlint --exchange``. The plan tables are verified
+# jax-free in analysis/exchck.py (LUX401-403); these rules prove the
+# properties only the traced step can show: that the local-edge
+# contribution is data-independent of the collective (the overlap
+# contract), that pad values annihilate under the program's combiner,
+# and that the advertised per-iteration collective bytes match what the
+# jaxpr actually moves.
+
+# The exchange data plane: collectives that MOVE VALUE ROWS between
+# shards. psum/psum_scatter/ppermute are merge- or control-plane (they
+# combine, not transport) and are deliberately excluded from the byte
+# accounting — the executors' exchange_bytes_per_iter models price only
+# the row transport.
+DATA_COLLECTIVE_PRIMS = ("all_gather", "all_to_all")
+
+
+def _is_data_collective(name: str) -> bool:
+    return any(
+        name == c or name.startswith(c + "_") for c in DATA_COLLECTIVE_PRIMS
+    )
+
+
+def _walk_jaxprs(jaxpr) -> Iterable:
+    """Depth-first walk over a jaxpr and every sub-jaxpr it carries."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for sub in _as_jaxprs(v):
+                yield from _walk_jaxprs(sub)
+
+
+def _is_lit(v) -> bool:
+    """Literal operands carry ``val``; Vars don't (identity-free check
+    that survives jax moving Literal between modules)."""
+    return hasattr(v, "val")
+
+
+# Per-trace memo for the dataflow/scalar analyses: LUX404 and LUX405
+# both need the same global walk, and recomputing it doubles the
+# exchange tier's wall cost. Keyed by identity with the closed jaxpr
+# pinned in the entry so a recycled id can never alias a stale result.
+_FLOW_MEMO: dict = {}
+
+
+def _flow_memo(closed, key: str, builder):
+    ent = _FLOW_MEMO.get(id(closed))
+    if ent is None or ent[0] is not closed:
+        if len(_FLOW_MEMO) > 32:
+            _FLOW_MEMO.clear()
+        ent = (closed, {})
+        _FLOW_MEMO[id(closed)] = ent
+    if key not in ent[1]:
+        ent[1][key] = builder(closed)
+    return ent[1][key]
+
+
+def _global_dataflow(closed) -> Tuple[set, set, set]:
+    return _flow_memo(closed, "flow", _global_dataflow_impl)
+
+
+def _global_dataflow_impl(closed) -> Tuple[set, set, set]:
+    """(tainted, axis, inputs) var sets over the WHOLE trace: vars
+    transitively computed from a data collective's output, from
+    ``axis_index``, and from the top jaxpr's invars respectively.
+
+    Membership is propagated THROUGH sub-jaxpr boundaries (pjit /
+    shard_map / cond / scan) by positional invar/outvar mapping — jnp
+    helpers like ``jnp.where`` trace as nested pjit calls, so the
+    local/remote merge usually sits one boundary below the collective
+    and a per-jaxpr walk would be blind to it. Where an eqn's operand
+    list cannot be aligned with a sub-jaxpr's invars (e.g. ``while``
+    packing two consts lists), propagation degrades to the conservative
+    union. Single forward pass: jaxpr equations are topologically
+    ordered (loop-carried taint inside scan/while bodies is not chased
+    to fixpoint; the step targets are single-iteration functions)."""
+    tainted: set = set()
+    axis: set = set()
+    inputs: set = set()
+    sets = (tainted, axis, inputs)
+
+    def member(v) -> Tuple[bool, bool, bool]:
+        if _is_lit(v):
+            return (False, False, False)
+        return tuple(v in s for s in sets)
+
+    def mark(v, mem) -> None:
+        for s, m in zip(sets, mem):
+            if m:
+                s.add(v)
+
+    def union(mems):
+        out = (False, False, False)
+        for m in mems:
+            out = tuple(a or b for a, b in zip(out, m))
+        return out
+
+    def visit(jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            nm = eqn.primitive.name
+            subs: List = []
+            for p in eqn.params.values():
+                subs.extend(_as_jaxprs(p))
+            if subs:
+                outer = list(eqn.invars)
+                if nm == "cond" and \
+                        all(len(s.invars) == len(outer) - 1 for s in subs):
+                    outer = outer[1:]   # predicate precedes the operands
+                if all(len(s.invars) == len(outer) for s in subs):
+                    for s in subs:
+                        for o, iv in zip(outer, s.invars):
+                            mark(iv, member(o))
+                        visit(s)
+                    if all(len(s.outvars) == len(eqn.outvars) for s in subs):
+                        for s in subs:
+                            for so, eo in zip(s.outvars, eqn.outvars):
+                                mark(eo, member(so))
+                        continue
+                    mem = union(member(so) for s in subs
+                                for so in s.outvars)
+                    for eo in eqn.outvars:
+                        mark(eo, mem)
+                    continue
+                # Unalignable boundary: conservative union in and out.
+                mem = union(member(v) for v in eqn.invars)
+                for s in subs:
+                    for iv in s.invars:
+                        mark(iv, mem)
+                    visit(s)
+                mem = union([mem] + [member(so) for s in subs
+                                     for so in s.outvars])
+                for eo in eqn.outvars:
+                    mark(eo, mem)
+                continue
+            mem = union(member(v) for v in eqn.invars)
+            if _is_data_collective(nm):
+                mem = (True, mem[1], mem[2])
+            if nm == "axis_index":
+                mem = (mem[0], True, mem[2])
+            for ov in eqn.outvars:
+                mark(ov, mem)
+
+    inputs.update(closed.jaxpr.invars)
+    visit(closed.jaxpr)
+    return tainted, axis, inputs
+
+
+def _eqn_ordinals(jaxpr) -> dict:
+    """id(eqn) -> 1-based ordinal in the same depth-first walk the
+    other IR rules number findings by."""
+    return {id(e): k for k, e in enumerate(iter_eqns(jaxpr), start=1)}
+
+
+def _lit_scalar(v) -> Optional[float]:
+    """The numeric value of a scalar Literal (or None)."""
+    if not _is_lit(v):
+        return None
+    a = np.asarray(v.val)
+    if a.size != 1 or a.dtype.kind not in "bifu":
+        return None
+    return float(a.reshape(-1)[0])
+
+
+# Primitives through which a known scalar constant keeps its value
+# (shape/dtype bookkeeping only — dtype conversion of +-inf and the
+# integer identities is exact for the cases LUX405 compares).
+_VALUE_PRESERVING_PRIMS = (
+    "broadcast_in_dim", "reshape", "convert_element_type", "squeeze",
+    "expand_dims", "copy", "slice",
+)
+
+
+def _closed_subs(v) -> List[Tuple[object, tuple]]:
+    """(jaxpr, consts) pairs for sub-jaxprs, keeping ClosedJaxpr consts
+    paired with their constvars (``_as_jaxprs`` drops them)."""
+    from jax import core as jcore
+
+    if isinstance(v, jcore.ClosedJaxpr):
+        return [(v.jaxpr, tuple(v.consts))]
+    if isinstance(v, jcore.Jaxpr):
+        return [(v, ())]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_closed_subs(x))
+        return out
+    return []
+
+
+def _scalar_env(closed) -> dict:
+    return _flow_memo(closed, "scalars", _scalar_env_impl)
+
+
+def _scalar_env_impl(closed) -> dict:
+    """Global scalar constant propagation: Var -> float for every var
+    that provably holds one scalar value, across pjit/shard_map/cond
+    boundaries (positional invar mapping) and through shape-only ops.
+    This is how LUX405 recovers the pad constants the executors build
+    with ``identity_for`` — by trace time they are consts threaded into
+    the shard_map body, not Literals at the select."""
+    env: dict = {}
+
+    def value_of(v):
+        lv = _lit_scalar(v)
+        if lv is not None:
+            return lv
+        return env.get(v)
+
+    def seed(jaxpr, consts):
+        for cv, c in zip(jaxpr.constvars, consts):
+            try:
+                a = np.asarray(c)
+            except Exception:
+                continue
+            if a.size == 1 and a.dtype.kind in "bifu":
+                env[cv] = float(a.reshape(-1)[0])
+
+    def visit(jaxpr):
+        for eqn in jaxpr.eqns:
+            nm = eqn.primitive.name
+            if nm in _VALUE_PRESERVING_PRIMS and eqn.invars:
+                val = value_of(eqn.invars[0])
+                if val is not None:
+                    for ov in eqn.outvars:
+                        env[ov] = val
+            for p in eqn.params.values():
+                for sub, consts in _closed_subs(p):
+                    seed(sub, consts)
+                    outer = list(eqn.invars)
+                    # cond consumes the predicate before the operands.
+                    if nm == "cond" and len(outer) == len(sub.invars) + 1:
+                        outer = outer[1:]
+                    if len(outer) == len(sub.invars):
+                        for o, iv in zip(outer, sub.invars):
+                            val = value_of(o)
+                            if val is not None:
+                                env[iv] = val
+                    visit(sub)
+
+    seed(closed.jaxpr, tuple(closed.consts))
+    visit(closed.jaxpr)
+    return env
+
+
+def _combiner_identity(combiner: str, dtype) -> Optional[float]:
+    """The annihilator value for a combiner over ``dtype`` — mirrors
+    ops/segment.identity_for (kept numerically identical by test)."""
+    dt = np.dtype(dtype)
+    if combiner == "sum":
+        return 0.0
+    if combiner == "min":
+        return float(np.inf) if dt.kind == "f" else float(np.iinfo(dt).max)
+    if combiner == "max":
+        return float(-np.inf) if dt.kind == "f" else float(np.iinfo(dt).min)
+    return None
+
+
+class OverlapProof(IRRule):
+    id = "LUX404"
+    title = "overlap-proof"
+    doc = ("compact targets must merge an untainted input-derived local "
+           "contribution against the collective's result — proves the "
+           "local-edge work is data-independent of the exchange")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        if target.exchange_mode != "compact":
+            return
+        ordinals = _eqn_ordinals(closed.jaxpr)
+        tainted, axis, inputs = _global_dataflow(closed)
+        good: List = []
+        bad: List = []
+        saw_collective = False
+        for eqn in iter_eqns(closed.jaxpr):
+            nm = eqn.primitive.name
+            if _is_data_collective(nm):
+                saw_collective = True
+            elif nm in ("select_n", "select") and len(eqn.invars) >= 3:
+                # The local/remote merge: predicate derived from
+                # axis_index (ownership test), at least one case from
+                # the collective. The merge is proven iff some case is
+                # an untainted function of the step's own inputs — the
+                # local contribution.
+                pred, cases = eqn.invars[0], eqn.invars[1:]
+                if _is_lit(pred) or pred not in axis or pred in tainted:
+                    continue
+                if not any((not _is_lit(c)) and c in tainted
+                           for c in cases):
+                    continue
+                ok = any((not _is_lit(c)) and c not in tainted
+                         and c in inputs for c in cases)
+                (good if ok else bad).append(eqn)
+            elif nm == "dynamic_update_slice" and len(eqn.invars) >= 3:
+                # The tiled merge: own shard written into the gathered
+                # table at an axis-derived offset.
+                op, upd = eqn.invars[0], eqn.invars[1]
+                starts = eqn.invars[2:]
+                if not any((not _is_lit(s)) and s in axis
+                           for s in starts):
+                    continue
+                if not any((not _is_lit(x)) and x in tainted
+                           for x in (op, upd)):
+                    continue
+                ok = (not _is_lit(upd)) and upd not in tainted \
+                    and upd in inputs
+                (good if ok else bad).append(eqn)
+        if not saw_collective:
+            return   # no exchange traced at all — LUX105's finding
+        if good:
+            return   # overlap proven: local side never waits on the wire
+        if bad:
+            eqn = bad[0]
+            yield self.finding(
+                target, ordinals.get(id(eqn), 0),
+                f"local/remote merge `{eqn.primitive.name}` consumes the "
+                "collective's result on every data side — the local-edge "
+                "contribution transitively depends on the exchange, so "
+                "the advertised compute/communication overlap cannot "
+                "exist",
+            )
+        else:
+            yield self.finding(
+                target, 0,
+                "no local/remote merge point found downstream of the "
+                "data collective — cannot prove the local-edge "
+                "contribution is independent of the exchange",
+            )
+
+
+class SentinelAnnihilator(IRRule):
+    id = "LUX405"
+    title = "sentinel-annihilator"
+    doc = ("pad values merged into the exchanged data path must be the "
+           "program combiner's identity (+inf/int-max for min, 0 for "
+           "sum) so sentinel traffic can never reach a result")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        if target.exchange_mode != "compact" or \
+                target.combiner not in ("min", "max", "sum"):
+            return
+        comb = target.combiner
+        vdt = np.dtype(target.value_dtype) if target.value_dtype else None
+        env = _scalar_env(closed)
+        ordinals = _eqn_ordinals(closed.jaxpr)
+        tainted, _, _ = _global_dataflow(closed)
+        wrong: List[Tuple] = []
+        found_ident = False
+        saw_collective = False
+        for eqn in iter_eqns(closed.jaxpr):
+            nm = eqn.primitive.name
+            if _is_data_collective(nm):
+                saw_collective = True
+            elif nm in ("select_n", "select") and len(eqn.invars) >= 3:
+                cases = eqn.invars[1:]
+                if not any((not _is_lit(c)) and c in tainted
+                           for c in cases):
+                    continue
+                dt = np.dtype(getattr(eqn.outvars[0].aval, "dtype",
+                                      np.float32))
+                if dt.kind == "b":
+                    continue   # frontier masks, no numeric identity
+                if vdt is not None and dt != vdt:
+                    continue   # index/queue plane, not the value rows
+                ident = _combiner_identity(comb, dt)
+                for c in cases:
+                    val = _lit_scalar(c)
+                    if val is None and not _is_lit(c):
+                        val = env.get(c)
+                    if val is None:
+                        continue
+                    if val == ident:
+                        found_ident = True
+                    else:
+                        wrong.append((eqn, val, ident, dt))
+            elif comb == "sum" and nm.startswith("scatter") and \
+                    len(eqn.invars) >= 3:
+                # Summing programs annihilate pads by scattering into a
+                # zero-filled receive buffer: a nonzero fill would be
+                # added into every touched row.
+                op, upd = eqn.invars[0], eqn.invars[2]
+                if _is_lit(upd) or upd not in tainted:
+                    continue
+                val = _lit_scalar(op)
+                if val is None and not _is_lit(op):
+                    val = env.get(op)
+                if val is None:
+                    continue
+                dt = np.dtype(getattr(eqn.outvars[0].aval, "dtype",
+                                      np.float32))
+                if vdt is not None and dt != vdt:
+                    continue   # index/queue plane, not the value rows
+                if val == 0.0:
+                    found_ident = True
+                else:
+                    wrong.append((eqn, val, 0.0, dt))
+        for eqn, val, ident, dt in wrong:
+            yield self.finding(
+                target, ordinals.get(id(eqn), 0),
+                f"pad constant {val:g} flows into the exchanged data "
+                f"path through `{eqn.primitive.name}` but the {comb} "
+                f"identity for {dt.name} is {ident:g} — sentinel slots "
+                "leak into results",
+            )
+        if saw_collective and not wrong and not found_ident:
+            yield self.finding(
+                target, 0,
+                f"no {comb}-identity pad constant guards the exchanged "
+                "candidates — cannot prove sentinel traffic is "
+                "annihilated before the combiner",
+            )
+
+
+def _collective_byte_totals(jaxpr, num_parts: int) -> set:
+    """Set of possible per-iteration data-collective byte totals for
+    one step. A set, not a number: ``cond`` branches are execution
+    ALTERNATIVES (the push engine's sparse/dense split), so each branch
+    contributes its own total; everything else composes additively.
+    Pricing (whole-mesh bytes crossing the interconnect per iteration,
+    operand = the per-shard array inside shard_map):
+
+    - all_gather: every shard receives every OTHER shard's operand —
+      ``P * (P-1) * operand_bytes``;
+    - all_to_all: each shard keeps its own 1/P chunk and sends the
+      rest — ``(P-1) * operand_bytes`` summed over the mesh.
+    """
+    P = num_parts
+    totals = {0}
+    for eqn in jaxpr.eqns:
+        nm = eqn.primitive.name
+        if _is_data_collective(nm):
+            opb = sum(_aval_bytes(v.aval) for v in eqn.invars
+                      if hasattr(v, "aval"))
+            add = {P * (P - 1) * opb if nm.startswith("all_gather")
+                   else (P - 1) * opb}
+        elif nm == "cond":
+            add = set()
+            for sub in _as_jaxprs(eqn.params.get("branches", ())):
+                add |= _collective_byte_totals(sub, P)
+        else:
+            add = {0}
+            for p in eqn.params.values():
+                for sub in _as_jaxprs(p):
+                    sub_totals = _collective_byte_totals(sub, P)
+                    add = {a + s for a in add for s in sub_totals}
+        if add and add != {0}:
+            totals = {t + a for t in totals for a in add}
+            if len(totals) > 1024:   # runaway-branch backstop
+                totals = set(sorted(totals)[:1024])
+    return totals
+
+
+class ExchangeByteAccounting(IRRule):
+    id = "LUX406"
+    title = "exchange-byte-accounting"
+    doc = ("the executor's exchange_bytes_per_iter claim must equal the "
+           "per-iteration data-collective bytes statically derived from "
+           "the traced step")
+
+    def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
+        if target.exchange_bytes is None or target.num_parts < 2:
+            return
+        totals = _collective_byte_totals(closed.jaxpr, target.num_parts)
+        if int(target.exchange_bytes) not in totals:
+            shown = ", ".join(str(t) for t in sorted(totals)[:8])
+            yield self.finding(
+                target, 0,
+                f"executor claims exchange_bytes_per_iter = "
+                f"{target.exchange_bytes} but the traced step's data "
+                f"collectives move {{{shown}}} bytes per iteration "
+                "(all_gather P*(P-1)*operand, all_to_all (P-1)*operand; "
+                "cond branches are alternatives) — the byte model "
+                "drifted from the exchange the step performs",
+            )
+
+
+def exchange_ir_rules(select=None) -> List[IRRule]:
+    rules: List[IRRule] = [
+        OverlapProof(), SentinelAnnihilator(), ExchangeByteAccounting(),
+    ]
+    if select:
+        rules = [r for r in rules if r.id in select]
+    return rules
+
+
 # -- runner -------------------------------------------------------------
+
+def check_target(target: TraceTarget,
+                 rules: Sequence[IRRule]) -> FileResult:
+    """Trace one target and run the given rules over its jaxpr."""
+    try:
+        closed = trace_target(target)
+    except Exception as e:   # traced user code: anything can raise
+        return FileResult(
+            target.name, [], [],
+            error=f"{target.name}: trace failed: {e!r}")
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for rule in rules:
+        try:
+            findings.extend(rule.check(closed, target))
+        except Exception as e:
+            errors.append(f"{target.name}: {rule.id} crashed: {e!r}")
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return FileResult(
+        target.name, findings, [], error="; ".join(errors) or None)
+
 
 def run_targets(targets: Sequence[TraceTarget],
                 rules: Optional[Sequence[IRRule]] = None) -> LintReport:
@@ -420,24 +941,7 @@ def run_targets(targets: Sequence[TraceTarget],
     t0 = time.perf_counter()
     if rules is None:
         rules = all_ir_rules()
-    results: List[FileResult] = []
-    for t in targets:
-        try:
-            closed = trace_target(t)
-        except Exception as e:   # traced user code: anything can raise
-            results.append(FileResult(
-                t.name, [], [], error=f"{t.name}: trace failed: {e!r}"))
-            continue
-        findings: List[Finding] = []
-        errors: List[str] = []
-        for rule in rules:
-            try:
-                findings.extend(rule.check(closed, t))
-            except Exception as e:
-                errors.append(f"{t.name}: {rule.id} crashed: {e!r}")
-        findings.sort(key=lambda f: (f.line, f.rule))
-        results.append(FileResult(
-            t.name, findings, [], error="; ".join(errors) or None))
+    results = [check_target(t, rules) for t in targets]
     return LintReport(results, time.perf_counter() - t0, schema=IR_SCHEMA)
 
 
@@ -511,29 +1015,34 @@ def _compact_graph(kind: str, weighted: bool, seed: int):
     return g
 
 
-def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
-    """Trace targets for every registered program x capable executor.
-    Sharded kinds are traced twice: once with the default full exchange
-    and once under ``LUX_EXCHANGE=compact`` (``{name}@{kind}+compact``),
-    so LUX104/LUX105 audit the packed all_to_all path too."""
+def _registry_executors(include_sharded: bool = True,
+                        sharded_only: bool = False):
+    """Yield ``(name, kind, executor, init_kw)`` for every registered
+    program x capable executor. Sharded kinds are built twice: once
+    with the default full exchange and once under
+    ``LUX_EXCHANGE=compact`` (``{name}@{kind}+compact``), so the audits
+    cover the packed all_to_all path too."""
     import os
 
     from lux_tpu.models import PROGRAMS, ROOTED_APPS, engine_kinds
     from lux_tpu.utils.logging import get_logger
 
-    targets: List[TraceTarget] = []
     for i, name in enumerate(sorted(PROGRAMS)):
         program = PROGRAMS[name]()
         weighted = bool(getattr(program, "needs_weights", False))
-        graph = _tiny_graph(weighted=weighted, seed=7 + i)
+        graph = None
         init_kw = {"start": 0} if name in ROOTED_APPS else {}
         for kind in engine_kinds(name):
-            if not include_sharded and kind.endswith("sharded"):
+            sharded = kind.endswith("sharded")
+            if sharded and not include_sharded:
                 continue
+            if sharded_only and not sharded:
+                continue
+            if graph is None:
+                graph = _tiny_graph(weighted=weighted, seed=7 + i)
             ex = build_executor(kind, graph, program)
-            spec = ex.trace_step(**init_kw)
-            targets.append(target_from_spec(f"{name}@{kind}", spec))
-            if not kind.endswith("sharded"):
+            yield f"{name}@{kind}", kind, ex, init_kw
+            if not sharded:
                 continue
             # luxlint: disable=LUX005 -- save/restore needs the raw set-vs-unset env entry, which the typed accessors erase
             prev = os.environ.get("LUX_EXCHANGE")
@@ -553,9 +1062,142 @@ def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
                     "compact collectives untraced for this target",
                     name, kind)
                 continue
-            targets.append(target_from_spec(
-                f"{name}@{kind}+compact", exc.trace_step(**init_kw)))
-    return targets
+            yield f"{name}@{kind}+compact", kind, exc, init_kw
+
+
+def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
+    """Trace targets for every registered program x capable executor
+    (see ``_registry_executors`` for the compact-variant policy)."""
+    return [
+        target_from_spec(name, ex.trace_step(**init_kw))
+        for name, _, ex, init_kw in _registry_executors(include_sharded)
+    ]
+
+
+# Value-row byte price per exchanged unit row for each plan-carrying
+# executor kind — the same figures the engines' exchange_bytes_per_iter
+# models use (pull: program row width x value itemsize; push: 4 B
+# uint32 value + 1 B bool frontier per lane; tiled: float32 elements).
+def _exchange_row_bytes(kind: str, ex) -> Optional[int]:
+    if kind == "pull_sharded":
+        return int(ex._row_bytes())
+    if kind == "push_sharded":
+        return 5
+    if kind == "push_multi_sharded":
+        return 5 * int(ex.k)
+    if kind == "tiled_sharded":
+        return 4
+    return None
+
+
+def _plan_evidence(kind: str, ex, plan) -> dict:
+    """LUX402/403 evidence for a live plan-carrying executor: the
+    remote-read counts matrix, the row price, and the exchange ledger
+    exactly as the observatory would publish it."""
+    from lux_tpu.obs import engobs
+
+    row_bytes = _exchange_row_bytes(kind, ex)
+    counts = None
+    ledger = None
+    sg = getattr(ex, "sg", None)
+    if sg is not None and hasattr(sg, "remote_read_counts"):
+        counts = sg.remote_read_counts()
+        if counts is not None and row_bytes is not None:
+            ledger = engobs.useful_exchange(
+                sg, row_bytes,
+                exchanged_rows=plan.exchanged_units_per_iter)
+    if counts is None:
+        counts = getattr(ex, "_remote_read_counts", None)
+        if counts is not None and row_bytes is not None:
+            # The tiled executor's block-granular ledger (its run()
+            # computes the same figures inline).
+            c = np.asarray(counts, np.int64)
+            exchanged = plan.exchanged_units_per_iter * plan.unit_rows
+            useful = int(c.sum() - np.trace(c))
+            ledger = {
+                "useful_rows": useful,
+                "exchanged_rows": exchanged,
+                "useful_bytes_per_iter": useful * row_bytes,
+                "ratio": useful / max(exchanged, 1),
+            }
+    return {"remote_read_counts": counts, "row_bytes": row_bytes,
+            "ledger": ledger}
+
+
+def run_exchange_matrix(select=None) -> LintReport:
+    """``luxlint --exchange`` with no paths: the LUX404-406 dataflow
+    rules over every full+compact sharded registry target, plus the
+    jax-free LUX401-403 plan rules over each live compact plan
+    (reported as ``{target}/plan``)."""
+    from lux_tpu.analysis import exchck
+
+    ir_rules = exchange_ir_rules(select)
+    plan_rules = [r for r in exchck.all_exchange_rules()
+                  if select is None or r.id in select]
+    # Executor construction is environment setup, not verification —
+    # keep it outside the timer exactly like the IR tier does (its
+    # registry_targets build happens before run_targets starts timing).
+    staged = list(_registry_executors(sharded_only=True))
+    results: List[FileResult] = []
+    t0 = time.perf_counter()
+    for name, kind, ex, init_kw in staged:
+        t = target_from_spec(name, ex.trace_step(**init_kw))
+        results.append(check_target(t, ir_rules))
+        if t.plan is not None:
+            view = exchck.plan_view(
+                t.plan, declared_bytes_per_iter=t.exchange_bytes,
+                **_plan_evidence(kind, ex, t.plan))
+            results.append(exchck.verify_exchange_plan(
+                view, f"{name}/plan", plan_rules))
+    return LintReport(results, time.perf_counter() - t0,
+                      schema=exchck.EXCHANGE_SCHEMA)
+
+
+def run_exchange_paths(paths: Sequence[str], select=None) -> LintReport:
+    """``luxlint --exchange`` over explicit paths: ``.py`` fixtures
+    exposing ``TRACES`` (IR rules) and/or ``PLANS`` (plan rules), and
+    saved exchange-artifact directories."""
+    import os
+
+    from lux_tpu.analysis import exchck
+
+    t0 = time.perf_counter()
+    ir_rules = exchange_ir_rules(select)
+    plan_rules = [r for r in exchck.all_exchange_rules()
+                  if select is None or r.id in select]
+    results: List[FileResult] = []
+    for path in paths:
+        if os.path.isdir(path):
+            try:
+                view = exchck.load_exchange_artifact(path)
+            except Exception as e:
+                results.append(FileResult(
+                    path, [], [],
+                    error=f"{path}: unloadable plan: {e!r}"))
+                continue
+            results.append(
+                exchck.verify_exchange_plan(view, path, plan_rules))
+            continue
+        try:
+            try:
+                targets = load_fixture_targets(path)
+            except ValueError:
+                targets = []     # PLANS-only fixture
+            plans = exchck.load_fixture_plans(path)
+        except Exception as e:
+            results.append(FileResult(
+                path, [], [], error=f"{path}: unloadable fixture: {e!r}"))
+            continue
+        if not targets and not plans:
+            results.append(FileResult(
+                path, [], [],
+                error=f"{path}: fixture exposes neither TRACES nor PLANS"))
+            continue
+        results.extend(check_target(t, ir_rules) for t in targets)
+        results.extend(exchck.verify_exchange_plan(v, nm, plan_rules)
+                       for nm, v in plans)
+    return LintReport(results, time.perf_counter() - t0,
+                      schema=exchck.EXCHANGE_SCHEMA)
 
 
 def load_fixture_targets(path: str) -> List[TraceTarget]:

@@ -326,7 +326,8 @@ class ShardedPullExecutor:
     def trace_step(self, **init_kw):
         """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
         sharded=True, so LUX105 demands the exchange all-gather shows
-        up in the trace."""
+        up in the trace. The exchange_* keys feed the LUX404-406
+        collective-dataflow rules (``luxlint --exchange``)."""
         return {
             "kind": "pull_sharded",
             "fn": self._step,
@@ -334,6 +335,13 @@ class ShardedPullExecutor:
             "donate": (0,),
             "carry": (0,),
             "sharded": True,
+            "exchange_mode": self.exchange_mode,
+            "exchange_bytes": self.exchange_bytes_per_iter(),
+            "combiner": getattr(self.program, "combiner", ""),
+            "value_dtype": np.dtype(
+                getattr(self.program, "value_dtype", np.float32)).name,
+            "num_parts": self.num_parts,
+            "plan": self._xplan,
         }
 
     def _row_bytes(self) -> int:

@@ -1558,7 +1558,8 @@ class ShardedPushExecutor:
 
     def trace_step(self, **init_kw):
         """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
-        sharded=True, so LUX105 demands a collective in the trace."""
+        sharded=True, so LUX105 demands a collective in the trace. The
+        exchange_* keys feed LUX404-406 (``luxlint --exchange``)."""
         return {
             "kind": "push_sharded",
             "fn": self._step,
@@ -1566,6 +1567,13 @@ class ShardedPushExecutor:
             "donate": (0,),
             "carry": (0,),
             "sharded": True,
+            "exchange_mode": self.exchange_mode,
+            "exchange_bytes": self.exchange_bytes_per_iter(),
+            "combiner": getattr(self.program, "combiner", ""),
+            "value_dtype": np.dtype(
+                getattr(self.program, "value_dtype", np.uint32)).name,
+            "num_parts": self.num_parts,
+            "plan": self._xplan,
         }
 
     def exchange_bytes_per_iter(self) -> int:
@@ -1937,7 +1945,8 @@ class ShardedMultiSourcePushExecutor:
 
     def trace_step(self, start: int = 0, **init_kw):
         """luxlint-IR hook (analysis/ir.py): the jitted shard_map step;
-        sharded=True, so LUX105 demands a collective in the trace."""
+        sharded=True, so LUX105 demands a collective in the trace. The
+        exchange_* keys feed LUX404-406 (``luxlint --exchange``)."""
         return {
             "kind": "push_multi_sharded",
             "fn": self._step,
@@ -1945,6 +1954,13 @@ class ShardedMultiSourcePushExecutor:
             "donate": (0,),
             "carry": (0,),
             "sharded": True,
+            "exchange_mode": self.exchange_mode,
+            "exchange_bytes": self.exchange_bytes_per_iter(),
+            "combiner": getattr(self.program, "combiner", ""),
+            "value_dtype": np.dtype(
+                getattr(self.program, "value_dtype", np.uint32)).name,
+            "num_parts": self.num_parts,
+            "plan": self._xplan,
         }
 
     def exchange_bytes_per_iter(self) -> int:

@@ -695,15 +695,22 @@ class ShardedTiledExecutor:
     def trace_step(self, **init_kw):
         """luxlint-IR hook (analysis/ir.py): the jitted shard_map step
         with its real argument tuple; sharded=True, so LUX105 demands
-        the strip psum / exchange all-gather in the trace."""
+        the strip psum / exchange all-gather in the trace. The
+        exchange_* keys feed LUX404-406 (``luxlint --exchange``)."""
+        vals = self.init_values()
         return {
             "kind": "tiled_sharded",
             "fn": self._jstep,
-            "args": (self.init_values(), self._shard_args,
-                     self._replicated),
+            "args": (vals, self._shard_args, self._replicated),
             "donate": (0,),
             "carry": (0,),
             "sharded": True,
+            "exchange_mode": self.exchange_mode,
+            "exchange_bytes": self._exchange_bytes_per_iter(vals),
+            "combiner": getattr(self.program, "combiner", "sum"),
+            "value_dtype": np.dtype(vals.dtype).name,
+            "num_parts": self.num_parts,
+            "plan": self._xplan,
         }
 
     def _exchange_bytes_per_iter(self, vals) -> int:

@@ -23,6 +23,8 @@ Two deliberate divergences:
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -235,3 +237,56 @@ class ExchangePlan:
             needs, max_nv, P, unit_rows=1, multiple=multiple,
             capacity=capacity,
         )
+
+
+# -- exchange-plan artifact (consumed by the jax-free exchange linter) -----
+#
+# Layout mirrors the grouped-tail plan artifact (plan.py / planck.py):
+# one directory per plan, ``meta.json`` with the scalar fields plus one
+# ``.npy`` per table so the checker can mmap them without jax.
+# ``analysis/exchck.py`` mirrors these constants deliberately (it must
+# stay importable without this module's jax-adjacent neighbors); the
+# parity test in tests/test_exchck.py keeps the two in lockstep.
+
+EXCHANGE_PLAN_FORMAT = 1
+EXCHANGE_PLAN_ARRAYS = ("counts", "send_units", "recv_pos")
+
+
+def save_exchange_artifact(
+    plan: ExchangePlan,
+    path: str,
+    remote_read_counts: Optional[np.ndarray] = None,
+    row_bytes: Optional[int] = None,
+    ledger: Optional[dict] = None,
+) -> None:
+    """Write ``plan`` to ``path/`` for offline verification.
+
+    ``remote_read_counts`` (value rows, from ShardedGraph) enables the
+    LUX402 conservation proof; ``row_bytes`` and ``ledger`` (the
+    ``engobs.useful_exchange`` dict) enable the LUX403 pricing checks.
+    """
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "format": EXCHANGE_PLAN_FORMAT,
+        "num_parts": int(plan.num_parts),
+        "max_units": int(plan.max_units),
+        "unit_rows": int(plan.unit_rows),
+        "capacity": int(plan.capacity),
+        "profitable": bool(plan.profitable),
+        "exchanged_units_per_iter": int(plan.exchanged_units_per_iter),
+    }
+    if row_bytes is not None:
+        meta["row_bytes"] = int(row_bytes)
+        meta["exchange_bytes_per_iter"] = int(
+            plan.exchange_bytes_per_iter(row_bytes))
+    if ledger is not None:
+        meta["ledger"] = {k: (float(v) if k == "ratio" else int(v))
+                          for k, v in ledger.items()}
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    for name in EXCHANGE_PLAN_ARRAYS:
+        np.save(os.path.join(path, name + ".npy"),
+                np.asarray(getattr(plan, name)))
+    if remote_read_counts is not None:
+        np.save(os.path.join(path, "remote_read_counts.npy"),
+                np.asarray(remote_read_counts))

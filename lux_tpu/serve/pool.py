@@ -36,6 +36,7 @@ class EnginePool:
         # Created eagerly so a clean pool still exports 0 — the serve
         # dashboards alert on this going nonzero, not on its absence.
         self._ir_findings = metrics.counter("lux_ir_findings_total")
+        self._exch_findings = metrics.counter("lux_exch_findings_total")
         self._retired = metrics.counter("lux_serve_pool_retired_total")
         self.sentinel = RecompileSentinel(scope)
 
@@ -67,6 +68,7 @@ class EnginePool:
                         # luxlint: disable=LUX303 -- single-compile guarantee needs the lock
                         ex.warmup()
             self._audit(key, ex)
+            self._audit_exchange(key, ex)
             self._engines[key] = ex
             return ex
 
@@ -87,6 +89,22 @@ class EnginePool:
             return
         for f in findings:
             self._ir_findings.inc()
+            print(f"EnginePool: {f.format()}")
+
+    def _audit_exchange(self, key: Hashable, ex) -> None:
+        """LUX401-403 plan audit on the freshly built engine: pure numpy
+        over the live ExchangePlan tables, no tracing. A finding means
+        the packed all_to_all this engine is about to serve with drops
+        or duplicates rows — flagged once at build time
+        (``lux_exch_findings_total``), never per query."""
+        if not flags.get_bool("LUX_EXCH_POOL_AUDIT"):
+            return
+        if getattr(ex, "_xplan", None) is None:
+            return
+        from lux_tpu.analysis import exchck
+        findings = exchck.audit_exchange(ex, f"pool@{key}")
+        for f in findings:
+            self._exch_findings.inc()
             print(f"EnginePool: {f.format()}")
 
     def retire(self, predicate: Callable[[Hashable], bool]) -> int:
@@ -122,6 +140,7 @@ class EnginePool:
             "warmup_compiles": self.sentinel.compiles(),
             "recompiles": self.sentinel.recompiles(),
             "ir_findings": int(self._ir_findings.value),
+            "exch_findings": int(self._exch_findings.value),
         }
 
     def close(self):

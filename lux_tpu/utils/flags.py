@@ -245,6 +245,10 @@ define("LUX_PLANCK_INFLATION", 8.0,
        "luxlint-IR LUX205: max per-level grouped-tail stream inflation "
        "(rows per level / ceil(reals/128)) a saved plan may carry",
        kind="float")
+define("LUX_EXCH_POOL_AUDIT", True,
+       "run the LUX401-403 exchange-plan audit on every plan-carrying "
+       "engine the serve pool builds (pure numpy over the live "
+       "ExchangePlan tables; 0 disables)", kind="bool")
 
 # Concurrency discipline (utils/locks.py, tools/race_stress.py)
 define("LUX_LOCKWATCH", False,

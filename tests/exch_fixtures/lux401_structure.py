@@ -1,0 +1,41 @@
+"""Seeded LUX401 violation: a real-looking send entry leaks into the
+sentinel pad zone of an otherwise correct plan — pad traffic and real
+traffic sharing a slot is exactly what the prefix-density proof exists
+to forbid.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX401.
+"""
+
+import types
+
+import numpy as np
+
+
+def _base_plan():
+    # P=2 parts, max_units=4, unit_rows=1, capacity=2.
+    # Receiver-major counts: receiver 0 needs rows {1, 3} of sender 1,
+    # receiver 1 needs row {2} of sender 0.
+    counts = np.array([[0, 2], [1, 0]], dtype=np.int64)
+    send = np.array([[4, 4, 2, 4],
+                     [1, 3, 4, 4]], dtype=np.int32)
+    recv = np.array([[8, 8, 5, 7],
+                     [2, 8, 8, 8]], dtype=np.int32)
+    return types.SimpleNamespace(
+        num_parts=2, max_units=4, unit_rows=1, capacity=2,
+        counts=counts, send_units=send, recv_pos=recv, profitable=True)
+
+
+_plan = _base_plan()
+# expect: LUX401 (real entry in the sentinel pad zone of pair 0 -> 1)
+_plan.send_units[0, 3] = 1
+
+PLANS = [
+    {
+        "name": "lux401-pad-zone-leak",
+        "plan": _plan,
+        "remote_read_counts": np.array([[0, 2], [1, 0]], dtype=np.int64),
+        "row_bytes": 8,
+        "declared_bytes_per_iter": 32,
+    },
+]

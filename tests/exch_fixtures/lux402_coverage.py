@@ -1,0 +1,39 @@
+"""Seeded LUX402 violation: one real ``recv_pos`` entry scatters a row
+to the wrong flat index (6 instead of sender*max_units + row = 5), so
+the receiver's unchanged compute body would read a neighbor's value.
+Structure stays legal — bounds, sentinels, and prefix density all hold —
+so only the permutation proof can catch it.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX402.
+"""
+
+import types
+
+import numpy as np
+
+
+def _base_plan():
+    counts = np.array([[0, 2], [1, 0]], dtype=np.int64)
+    send = np.array([[4, 4, 2, 4],
+                     [1, 3, 4, 4]], dtype=np.int32)
+    recv = np.array([[8, 8, 5, 7],
+                     [2, 8, 8, 8]], dtype=np.int32)
+    return types.SimpleNamespace(
+        num_parts=2, max_units=4, unit_rows=1, capacity=2,
+        counts=counts, send_units=send, recv_pos=recv, profitable=True)
+
+
+_plan = _base_plan()
+# expect: LUX402 (sender 1 row 1 lands at flat index 6, bodies read 5)
+_plan.recv_pos[0, 2] = 6
+
+PLANS = [
+    {
+        "name": "lux402-misaligned-recv",
+        "plan": _plan,
+        "remote_read_counts": np.array([[0, 2], [1, 0]], dtype=np.int64),
+        "row_bytes": 8,
+        "declared_bytes_per_iter": 32,
+    },
+]

@@ -1,0 +1,36 @@
+"""Seeded LUX403 violation: the executor-declared
+``exchange_bytes_per_iter`` (48) disagrees with what the plan actually
+prices — ``exchanged_units_per_iter * unit_rows * row_bytes`` =
+2*1*2 units * 1 row * 8 B = 32 B. The tables themselves are perfect;
+only the profitability-honesty check can see the drift.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX403.
+"""
+
+import types
+
+import numpy as np
+
+
+def _base_plan():
+    counts = np.array([[0, 2], [1, 0]], dtype=np.int64)
+    send = np.array([[4, 4, 2, 4],
+                     [1, 3, 4, 4]], dtype=np.int32)
+    recv = np.array([[8, 8, 5, 7],
+                     [2, 8, 8, 8]], dtype=np.int32)
+    return types.SimpleNamespace(
+        num_parts=2, max_units=4, unit_rows=1, capacity=2,
+        counts=counts, send_units=send, recv_pos=recv, profitable=True)
+
+
+PLANS = [
+    {
+        "name": "lux403-inflated-declared-bytes",
+        "plan": _base_plan(),
+        "remote_read_counts": np.array([[0, 2], [1, 0]], dtype=np.int64),
+        "row_bytes": 8,
+        # expect: LUX403 (plan prices 32 B/iter, executor claims 48)
+        "declared_bytes_per_iter": 48,
+    },
+]

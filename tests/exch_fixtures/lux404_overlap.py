@@ -1,0 +1,39 @@
+"""Seeded LUX404 violation: a compact-mode step whose "local" branch
+reads the gathered table — every data side of the ownership merge then
+transitively consumes the collective's result, so nothing is left for
+XLA to overlap with the wire time. This is exactly the regression the
+overlap proof exists to catch (the real engines compute the local-edge
+contribution from their own shard only).
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX404.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_gathered_first(vals):
+    n = vals.shape[0]
+    tbl = jax.lax.all_gather(vals, "parts")
+    flat = tbl.reshape(-1)
+    # expect: LUX404 (the "local" side is computed FROM the gathered
+    # table, so the merge depends on the collective on every data side)
+    local = flat[:n] * 0.5
+    remote = flat[n:2 * n] + 1.0
+    own = jax.lax.axis_index("parts") == 0
+    return jnp.where(own, local, remote)
+
+
+TRACES = [
+    {
+        "name": "fixture@lux404-local-reads-gathered",
+        "call": _step_gathered_first,
+        "args": (jnp.zeros(64, jnp.float32),),
+        "carry": (0,),
+        "sharded": True,
+        "axis_env": (("parts", 4),),
+        "exchange_mode": "compact",
+        "num_parts": 4,
+    },
+]

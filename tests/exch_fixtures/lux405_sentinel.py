@@ -1,0 +1,40 @@
+"""Seeded LUX405 violation: a compact-mode min-combiner step that pads
+the exchanged candidates with 0.0 instead of the min identity (+inf).
+Every padded slot would then win the minimum and overwrite a real
+distance with zero. The step keeps an honest local/remote merge so the
+overlap proof (LUX404) stays green — only the annihilator check fires.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX405.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_zero_pad(vals):
+    n = vals.shape[0]
+    tbl = jax.lax.all_gather(vals, "parts")
+    flat = tbl.reshape(-1)
+    # expect: LUX405 (pad constant 0.0; the min identity is +inf)
+    gathered = jnp.where(flat < 1e30, flat, 0.0)[:n]
+    local = vals * 0.5
+    own = jax.lax.axis_index("parts") == 0
+    merged = jnp.where(own, local, gathered)
+    return jnp.minimum(merged, vals)
+
+
+TRACES = [
+    {
+        "name": "fixture@lux405-zero-pad-min",
+        "call": _step_zero_pad,
+        "args": (jnp.zeros(64, jnp.float32),),
+        "carry": (0,),
+        "sharded": True,
+        "axis_env": (("parts", 4),),
+        "exchange_mode": "compact",
+        "combiner": "min",
+        "value_dtype": "float32",
+        "num_parts": 4,
+    },
+]

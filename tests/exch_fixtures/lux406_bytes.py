@@ -1,0 +1,38 @@
+"""Seeded LUX406 violation: the step's one ``all_gather`` moves
+P*(P-1)*n*4 = 4*3*64*4 = 3072 bytes per iteration, but the executor
+metadata claims 1024 — the kind of silent drift that makes every
+downstream bandwidth model (ledger, bench gate, perf sheet) wrong while
+results stay bit-correct.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1 with
+exactly LUX406.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_honest_overlap(vals):
+    n = vals.shape[0]
+    tbl = jax.lax.all_gather(vals, "parts")
+    flat = tbl.reshape(-1)
+    local = vals * 0.5
+    remote = flat[n:2 * n] + 1.0
+    own = jax.lax.axis_index("parts") == 0
+    return jnp.where(own, local, remote)
+
+
+TRACES = [
+    {
+        "name": "fixture@lux406-understated-bytes",
+        "call": _step_honest_overlap,
+        "args": (jnp.zeros(64, jnp.float32),),
+        "carry": (0,),
+        "sharded": True,
+        "axis_env": (("parts", 4),),
+        "exchange_mode": "compact",
+        # expect: LUX406 (the trace's collective moves 3072 B/iter)
+        "exchange_bytes": 1024,
+        "num_parts": 4,
+    },
+]

@@ -179,3 +179,68 @@ def test_multi_source_compact_bytes_measured(monkeypatch):
             == ex_c._xplan.exchange_bytes_per_iter(5 * ex_c.k))
     assert (ex_c.exchange_bytes_per_iter()
             < out["full"][0].exchange_bytes_per_iter())
+
+
+# -- self-downgrade coverage ----------------------------------------------
+
+
+def test_released_edge_arrays_downgrade_logs_once(monkeypatch, caplog):
+    """Releasing the host edge arrays before a plan exists leaves nothing
+    to derive tables from: compact must self-downgrade to the full path
+    and say so exactly once — silent coverage loss is the failure mode
+    the log exists to prevent."""
+    import logging
+
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    g = generate.halo(4, 128, hubs=8)
+    sg = ShardedGraph.build(g, 4)
+    sg.release_edge_arrays()
+    assert sg.exchange_plan() is None
+    log = logging.getLogger("lux-test-downgrade")
+    with caplog.at_level(logging.INFO, logger="lux-test-downgrade"):
+        mode, plan = resolve_exchange(sg, log=log)
+    assert (mode, plan) == ("full", None)
+    records = [r for r in caplog.records
+               if "falling back to full" in r.getMessage()]
+    assert len(records) == 1
+    assert "released" in records[0].getMessage()
+
+
+def test_release_after_plan_keeps_compact(monkeypatch):
+    """Release AFTER the plan was built: the cached tables are all the
+    exchange needs, so compaction stays engaged."""
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    g = generate.halo(4, 128, hubs=8)
+    sg = ShardedGraph.build(g, 4)
+    plan = sg.exchange_plan()
+    assert plan is not None and plan.profitable
+    sg.release_edge_arrays()
+    mode, got = resolve_exchange(sg)
+    assert mode == "compact" and got is plan
+
+
+def test_serving_keys_carry_requested_mode(monkeypatch):
+    """A dense graph downgrades every sharded engine to the full
+    exchange, but pool keys still carry the REQUESTED mode — a warm
+    full-mode engine from before a flag flip must never answer for a
+    compact request, even when both would build the same program."""
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    from lux_tpu.obs import metrics
+    from lux_tpu.serve import ServeConfig, Session
+
+    metrics.reset()
+    g = generate.gnp(400, 12000, seed=3, weighted=True)
+    cfg = ServeConfig(max_batch=4, window_s=0.01, max_queue=64,
+                      pagerank_iters=4, mesh="8")
+    with Session(g, cfg, warm=False) as s:
+        np.testing.assert_array_equal(
+            s.query("sssp", start=0, timeout=120)["values"],
+            reference_sssp(g, 0))
+        keys = s.pool.keys()
+        assert keys, "no engine was built"
+        assert all("compact" in k for k in keys)
+        # ... while the engines themselves run the downgraded full path.
+        for k in keys:
+            ex = s.pool._engines[k]
+            assert getattr(ex, "exchange_mode", "full") == "full"
+            assert getattr(ex, "_xplan", None) is None

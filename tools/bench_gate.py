@@ -40,7 +40,7 @@ from lux_tpu.utils import flags  # noqa: E402
 
 _LOWER_IS_BETTER = re.compile(r"(_ms_per_iter|ms_per_iter|_seconds|_s)$")
 # Context keys that must match for two rounds to be comparable.
-_CONTEXT_KEYS = ("mode", "scale", "ef", "layout", "platform")
+_CONTEXT_KEYS = ("mode", "scale", "ef", "layout", "platform", "exchange")
 
 
 def log(msg):
@@ -129,6 +129,10 @@ def comparable(cur_ctx: dict, base_ctx: dict):
     a full-scale TPU round must never gate a fast CPU round."""
     for key in _CONTEXT_KEYS:
         c, b = cur_ctx.get(key), base_ctx.get(key)
+        if key == "exchange" and b is None:
+            # Baselines recorded before the exchange key existed ran
+            # under the then-only full exchange.
+            b = flags.default("LUX_EXCHANGE")
         if b is None and key in ("ef", "platform", "mode"):
             if key == "mode" and cur_ctx.get("mode") == "fast":
                 return False, "legacy baseline has no fast-mode context"
@@ -202,6 +206,10 @@ def run_bench(fast: bool):
         "ef": int(env.get("LUX_BENCH_EF", flags.default("LUX_BENCH_EF"))),
         "layout": env.get("LUX_BENCH_LAYOUT",
                           flags.default("LUX_BENCH_LAYOUT")),
+        # The requested sharded exchange mode: two bench runs with
+        # different LUX_EXCHANGE settings are different experiments and
+        # must never ratchet against each other silently.
+        "exchange": env.get("LUX_EXCHANGE", flags.default("LUX_EXCHANGE")),
         "platform": m.group(1) if m else "unknown",
     }
     return headline, context, " ".join(cmd)

@@ -146,6 +146,29 @@ def main() -> int:
             f"{comp_led['ratio']:.3f}")
     doc["ledger"] = ledger
 
+    # -- 3.5: static exchange-tier verification of the live plans -------
+    # The same proof `make lint-exchange` runs, but against THESE
+    # engines' plans with the full evidence chain (counts, pricing,
+    # ledger): the smoke must never pass on a plan luxlint would flag.
+    from lux_tpu.analysis import exchck
+
+    for app, row_bytes in (("sssp", 5), ("pagerank", 4)):
+        ex_c = apps[app]["compact"]["ex"]
+        view = exchck.plan_view(
+            ex_c._xplan,
+            remote_read_counts=ex_c.sg.remote_read_counts(),
+            row_bytes=row_bytes,
+            declared_bytes_per_iter=ex_c.exchange_bytes_per_iter(),
+            ledger=engobs.useful_exchange(
+                ex_c.sg, row_bytes,
+                exchanged_rows=ex_c._xplan.exchanged_units_per_iter))
+        res = exchck.verify_exchange_plan(view, f"smoke@{app}")
+        assert not res.findings and res.error is None, (
+            [f.format() for f in res.findings], res.error)
+    doc["exchange_lint_findings"] = 0
+    log("exchck: LUX401-403 clean on both live compact plans "
+        "(structure, permutation proof, pricing)")
+
     # -- 4: zero recompiles on every warm path --------------------------
     sent.assert_zero_recompiles()
     doc["recompiles"] = sent.recompiles()

@@ -16,6 +16,11 @@ Usage:
     python tools/luxlint.py --threads        # concurrency tier: lock
                                              #   discipline + lock-order graph
                                              #   (LUX3xx, stdlib AST)
+    python tools/luxlint.py --exchange       # exchange tier: verify every
+                                             #   sharded target's ExchangePlan
+                                             #   + collective dataflow (LUX4xx)
+    python tools/luxlint.py --exchange DIR   # verify saved exchange-plan
+                                             #   artifacts / fixture modules
     python tools/luxlint.py --baseline F     # snapshot/compare: only findings
                                              #   absent from F fail the run
 
@@ -30,6 +35,7 @@ Suppress an AST-tier finding inline, with a reason:
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
@@ -64,14 +70,42 @@ def _changed_paths() -> list:
     return sorted(out)
 
 
+_SPAN_CACHE: dict = {}
+
+
+def _span_hash(path: str, line: int) -> str:
+    """Content hash of the finding's source span: sha1 (16 hex chars) of
+    the stripped text of the flagged line. Returns "" when the path is
+    virtual (trace targets, plan dirs) or the line is out of range."""
+    if line < 1:
+        return ""
+    if path not in _SPAN_CACHE:
+        try:
+            with open(path, "rb") as fh:
+                _SPAN_CACHE[path] = fh.read().splitlines()
+        except OSError:
+            _SPAN_CACHE[path] = None
+    lines = _SPAN_CACHE[path]
+    if lines is None or line > len(lines):
+        return ""
+    return hashlib.sha1(lines[line - 1].strip()).hexdigest()[:16]
+
+
 def _baseline_key(path: str, f) -> str:
-    return f"{f.rule}\t{path}\t{f.message}"
+    """Ratchet key: (rule, path, source-span hash). Hashing the flagged
+    line's content instead of its number keeps keys stable across
+    unrelated edits that shift line numbers; renaming/rewriting the
+    flagged line itself re-opens the finding, which is the point of a
+    ratchet. Virtual paths (IR targets, plan artifacts) have no source
+    to hash and fall back to the message."""
+    span = _span_hash(path, f.line)
+    return f"{f.rule}\t{path}\t{span or f.message}"
 
 
 def _apply_baseline(report, baseline_path: str) -> int:
     """Snapshot-or-compare. Missing file: write current findings, pass.
-    Present: fail only on findings whose (rule, path, message) key is new.
-    Line numbers are deliberately not part of the key — unrelated edits
+    Present: fail only on findings whose _baseline_key is new. Line
+    numbers are deliberately not part of the key — unrelated edits
     shift them."""
     current = {}
     for res in report.results:
@@ -119,6 +153,29 @@ def _run_ir(paths, select: str):
     return ir.run_targets(targets, rules)
 
 
+def _run_exchange(paths, select: str):
+    """Exchange tier: verify ExchangePlan tables (LUX401-403) and the
+    collective-dataflow contract (LUX404-406). With no paths, the whole
+    compact+full sharded registry matrix; with paths, saved artifact
+    dirs and/or fixture modules exporting TRACES / PLANS. The analysis
+    only ever traces and stages tiny placement programs, so XLA's
+    backend optimizer is dead weight — turning it off roughly halves
+    the tier's wall cost."""
+    from lux_tpu.utils.platform import virtual_cpu_flags
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        virtual_cpu_flags(8) + " --xla_backend_optimization_level=0")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lux_tpu.analysis import ir
+
+    want = None
+    if select:
+        want = {s.strip() for s in select.split(",") if s.strip()}
+    if paths:
+        return ir.run_exchange_paths(paths, select=want)
+    return ir.run_exchange_matrix(select=want)
+
+
 def _run_plans(paths, select: str):
     from lux_tpu.analysis import planck
     rules = planck.all_plan_rules()
@@ -151,6 +208,13 @@ def main(argv=None) -> int:
                     help="run the concurrency tier (LUX301-305): thread-"
                          "shared state, lock-order graph, blocking-under-"
                          "lock, unjoined threads, publish discipline")
+    ap.add_argument("--exchange", action="store_true",
+                    help="run the exchange tier (LUX401-406): ExchangePlan "
+                         "structure/coverage/profitability plus the "
+                         "overlap-proof, sentinel-annihilator, and byte-"
+                         "accounting dataflow rules over every sharded "
+                         "registry target; with paths, verify saved "
+                         "exchange artifacts or fixture modules")
     ap.add_argument("--changed", action="store_true",
                     help="AST/threads tiers: restrict to .py files changed "
                          "vs git HEAD (plus untracked); the threads tier "
@@ -161,9 +225,9 @@ def main(argv=None) -> int:
                          "and pass; if present, fail only on new findings")
     args = ap.parse_args(argv)
 
-    if sum((args.ir, args.plans, args.threads)) > 1:
-        ap.error("--ir, --plans, and --threads are separate tiers; run "
-                 "them separately")
+    if sum((args.ir, args.plans, args.threads, args.exchange)) > 1:
+        ap.error("--ir, --plans, --threads, and --exchange are separate "
+                 "tiers; run them separately")
 
     if args.list_rules:
         for r in all_rules():
@@ -178,12 +242,39 @@ def main(argv=None) -> int:
                 print(f"{r.id}  {r.title}\n       {r.doc}")
         except Exception:
             pass
+        try:
+            from lux_tpu.analysis import exchck
+            for r in exchck.all_exchange_rules():
+                print(f"{r.id}  {r.title}\n       {r.doc}")
+        except Exception:
+            pass
         print("LUX101-105  jaxpr tier (dtype drift, host callbacks, "
               "footprint, donation, collectives) — run with --ir")
+        print("LUX404-406  exchange dataflow tier (overlap proof, sentinel "
+              "annihilation, byte accounting) — run with --exchange")
         return 0
 
     if args.ir:
         report = _run_ir(args.paths, args.select)
+    elif args.exchange:
+        if args.changed and not args.paths:
+            # The matrix verifies live engine/partition behaviour, not
+            # file text: skip it entirely unless an exchange-relevant
+            # source file changed.
+            relevant = ("lux_tpu/engine/", "lux_tpu/parallel/",
+                        "lux_tpu/graph/", "lux_tpu/analysis/",
+                        "lux_tpu/models", "lux_tpu/obs/")
+            changed = [p for p in _changed_paths()
+                       if os.path.relpath(p, _REPO).startswith(relevant)]
+            if not changed:
+                print("luxlint: --changed: no exchange-relevant files "
+                      "modified")
+                print("LUXLINT " + json.dumps(
+                    {"schema": "luxlint-exchange.v1", "files": 0,
+                     "findings": 0, "errors": 0, "ok": True},
+                    sort_keys=True))
+                return 0
+        report = _run_exchange(args.paths, args.select)
     elif args.plans:
         if not args.paths:
             ap.error("--plans requires at least one artifact directory")

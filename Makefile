@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -33,7 +33,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -82,6 +82,14 @@ gas-smoke:
 # a phase-fenced exchange_hidden_frac report.
 exchange-smoke:
 	python tools/exchange_smoke.py
+
+# Profiler acceptance (obs/prof.py): a REAL jax.profiler capture around
+# warm sharded steps parsed by the stdlib profile.v1 parser — both
+# region tags classified, interval math consistent, zero recompiles
+# with regions armed, /profilez guarded (403/429/200) under a
+# concurrent burst, /statusz budget labeling.
+prof-smoke:
+	python tools/prof_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

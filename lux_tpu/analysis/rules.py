@@ -696,6 +696,76 @@ class MetricNameDiscipline(Rule):
         return None
 
 
+class RegionNameDiscipline(Rule):
+    id = "LUX009"
+    title = "region-name-discipline"
+    doc = ("profiler region names must match lux\\.[a-z0-9_.]+: a "
+           "literal name passed to prof.region, jax.named_scope, or "
+           "jax.profiler.TraceAnnotation that breaks the pattern never "
+           "joins the profile.v1 phase accounting (the parser only "
+           "classifies lux.* tags), so the time it brackets silently "
+           "vanishes from exchange/compute attribution")
+
+    _NAME_RE = re.compile(r"lux\.[a-z0-9_.]+")
+    # Dotted-call tails that take a region/scope name as their first
+    # argument. `region` alone is also tracked when imported bare from
+    # obs.prof (mirrors LUX008's bare-factory tracking).
+    _TAILS = frozenset(("named_scope", "TraceAnnotation"))
+
+    def _bare_region_names(self, tree: ast.Module) -> Set[str]:
+        """Names bound by ``from ...prof import region`` (or an asname
+        of it) anywhere in the file."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if not (node.module or "").endswith("prof"):
+                continue
+            names.update(a.asname or a.name for a in node.names
+                         if a.name == "region")
+        return names
+
+    def _is_region_call(self, node: ast.Call, bare: Set[str]) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in bare
+        name = _dotted(func)
+        if name is None:
+            return False
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail == "region":
+            return "prof" in parts[:-1]
+        if tail in self._TAILS:
+            # jax.named_scope / jax.profiler.TraceAnnotation, however
+            # the jax module is spelled locally.
+            return True
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        bare = self._bare_region_names(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_region_call(node, bare):
+                continue
+            name_arg = node.args[0] if node.args else None
+            literal = (name_arg.value
+                       if isinstance(name_arg, ast.Constant)
+                       and isinstance(name_arg.value, str) else None)
+            if literal is None:
+                continue    # dynamic names validate at runtime
+            if not self._NAME_RE.fullmatch(literal):
+                out.append(self.finding(
+                    ctx, node,
+                    f"region name {literal!r} breaks the naming contract "
+                    "— must fullmatch lux.[a-z0-9_.]+ (lux. prefix, "
+                    "lowercase dotted segments) or the profile.v1 parser "
+                    "drops it from phase attribution"))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         HostSyncInHotLoop(),
@@ -706,4 +776,5 @@ def all_rules() -> List[Rule]:
         ClockDiscipline(),
         SwallowedException(),
         MetricNameDiscipline(),
+        RegionNameDiscipline(),
     ]

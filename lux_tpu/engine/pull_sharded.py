@@ -33,6 +33,7 @@ from lux_tpu.obs import (
     consume_compile_seconds,
     engobs,
     note_compile_seconds,
+    prof,
     recorder_for,
 )
 from lux_tpu.utils import compat
@@ -239,9 +240,16 @@ class ShardedPullExecutor:
         return new[None]
 
     def _shard_step(self, vals_blk, dg):
-        flat = self._exchange_block(vals_blk, dg)
-        acc = self._comp_block(vals_blk, flat, dg)
-        return self._update_block(vals_blk, acc, dg)
+        # prof regions tag the lowered ops per phase (static names, so
+        # executable cache keys — and hence recompiles — are unchanged);
+        # the scopes do not fence XLA's schedule, so the compact path's
+        # exchange/local-compute overlap still happens and shows up as
+        # intersecting intervals in a device profile.
+        with prof.region("lux.pull_sharded.exchange"):
+            flat = self._exchange_block(vals_blk, dg)
+        with prof.region("lux.pull_sharded.compute"):
+            acc = self._comp_block(vals_blk, flat, dg)
+            return self._update_block(vals_blk, acc, dg)
 
     # -- driver ----------------------------------------------------------
 

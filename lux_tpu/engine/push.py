@@ -52,6 +52,7 @@ from lux_tpu.obs import (
     consume_compile_seconds,
     engobs,
     note_compile_seconds,
+    prof,
     recorder_for,
 )
 from lux_tpu.ops.segment import identity_for, segment_reduce
@@ -1175,10 +1176,15 @@ class ShardedPushExecutor:
 
     def _iter_block(self, state: PushState, dg):
         """One dense iteration on this shard's (1, ...) blocks; returns the
-        new blocks and the *local* new-frontier count."""
-        loaded = self._dense_load(state, dg)
-        acc, _ = self._dense_comp(loaded, dg, state=state)
-        return self._merge_update(state, acc, dg)
+        new blocks and the *local* new-frontier count. prof regions tag
+        the lowered ops per phase (static names — no cache-key change);
+        the scopes do not fence the schedule, so compact-mode overlap
+        still happens and a device profile can measure it."""
+        with prof.region("lux.push_sharded.exchange"):
+            loaded = self._dense_load(state, dg)
+        with prof.region("lux.push_sharded.compute"):
+            acc, _ = self._dense_comp(loaded, dg, state=state)
+            return self._merge_update(state, acc, dg)
 
     # Sparse-iteration phases (same load/comp/update split).
 
@@ -1242,9 +1248,11 @@ class ShardedPushExecutor:
 
     def _sparse_block(self, state: PushState, dg, Q=None, E=None):
         """One sparse iteration (fused composition of the three phases)."""
-        all_q, all_qv = self._sparse_load(state, dg, Q)
-        cand, dstl, _ = self._sparse_comp(all_q, all_qv, dg, E)
-        return self._sparse_update(state, cand, dstl, dg)
+        with prof.region("lux.push_sharded.exchange"):
+            all_q, all_qv = self._sparse_load(state, dg, Q)
+        with prof.region("lux.push_sharded.compute"):
+            cand, dstl, _ = self._sparse_comp(all_q, all_qv, dg, E)
+            return self._sparse_update(state, cand, dstl, dg)
 
     def _decide_block(self, state: PushState, dg):
         """Per-shard active count + the replicated tier index (0 = dense,
@@ -1744,8 +1752,10 @@ class ShardedMultiSourcePushExecutor:
         """One dense K-lane iteration on this shard's (1, max_nv, K)
         blocks; returns the new blocks and the local new-frontier count
         (summed over lanes)."""
-        all_v, all_f = self._exchange_lanes_block(state, dg)
-        return self._compute_lanes_block(state, all_v, all_f, dg)
+        with prof.region("lux.push_multi_sharded.exchange"):
+            all_v, all_f = self._exchange_lanes_block(state, dg)
+        with prof.region("lux.push_multi_sharded.compute"):
+            return self._compute_lanes_block(state, all_v, all_f, dg)
 
     def _shard_step(self, state: PushState, dg):
         new_state, cnt = self._iter_block(state, dg)

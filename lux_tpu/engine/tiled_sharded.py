@@ -53,6 +53,7 @@ from lux_tpu.obs import (
     consume_compile_seconds,
     engobs,
     note_compile_seconds,
+    prof,
     recorder_for,
 )
 from lux_tpu.utils import compat
@@ -586,10 +587,16 @@ class ShardedTiledExecutor:
         return new[None]
 
     def _shard_step(self, vals_blk, dg, repl):
-        x2d = self._exchange_block(vals_blk, dg, repl)
-        acc = self._strips_block(x2d, dg, repl)
-        acc = acc + self._tail_block(x2d, dg)
-        return self._apply_block(vals_blk, acc, dg)
+        # prof regions: the value exchange vs the strip/tail/apply local
+        # work (the strips' psum_scatter rides the compute tag — it is
+        # the reduction's own collective, not the value exchange).
+        # Static names keep executable cache keys unchanged.
+        with prof.region("lux.tiled_sharded.exchange"):
+            x2d = self._exchange_block(vals_blk, dg, repl)
+        with prof.region("lux.tiled_sharded.compute"):
+            acc = self._strips_block(x2d, dg, repl)
+            acc = acc + self._tail_block(x2d, dg)
+            return self._apply_block(vals_blk, acc, dg)
 
     # -- driver (external vertex order at the API boundary) --------------
 

@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from contextlib import nullcontext
 from typing import Optional
 
 import numpy as np
@@ -77,7 +76,8 @@ def build_parser(name: str, push: bool) -> argparse.ArgumentParser:
     )
     p.add_argument("-save", help="write checkpoint npz after the run")
     p.add_argument("-resume", help="resume vertex state from checkpoint npz")
-    p.add_argument("-profile", help="capture a jax.profiler trace to DIR")
+    p.add_argument("-profile", help="capture a device-timeline trace to "
+                   "DIR (obs/prof.py; parse with tools/prof_summary.py)")
     p.add_argument(
         "-metrics", "--metrics", dest="metrics",
         help="append the run's telemetry (per-iteration records, "
@@ -249,11 +249,13 @@ def make_executor(g, program, args, log=None):
 
 
 def _profiler(dirname: Optional[str]):
-    if not dirname:
-        return nullcontext()
-    import jax
+    """``-profile DIR`` capture window: obs/prof.py owns the arming
+    semantics (nullcontext when unarmed, makedirs + jax.profiler.trace
+    when armed), so the CLI, bench --profile, and POST /profilez all
+    write identical artifacts."""
+    from lux_tpu.obs import prof
 
-    return jax.profiler.trace(dirname)
+    return prof.trace(dirname)
 
 
 def final_values(ex, result) -> np.ndarray:

@@ -17,10 +17,14 @@ Environment knobs (all optional; everything is a no-op when unset):
   dumps on shed/reject/exception/SIGUSR1.
 - ``LUX_FLIGHT_CAPACITY=<n>`` / ``LUX_STATUSZ_WINDOWS=<s,s>`` — flight
   ring size and /statusz rolling-window lengths.
+- ``LUX_PROF_DIR=<dir>`` — arm the device-timeline profiler
+  (obs/prof.py): capture windows (bench ``--profile``, ``POST
+  /profilez``, SIGUSR2 toggle) write TensorBoard artifacts and
+  ``profile.v1`` reports under this directory.
 """
 
 from ..utils import logging as _logging
-from . import flight, metrics, report, slo, spans, trace
+from . import flight, metrics, prof, report, slo, spans, trace
 from .iterlog import (
     NULL_RECORDER,
     IterationRecorder,
@@ -33,7 +37,7 @@ from .iterlog import (
 )
 
 __all__ = [
-    "metrics", "trace", "report", "spans", "flight", "slo",
+    "metrics", "trace", "report", "spans", "flight", "slo", "prof",
     "IterationRecorder", "NULL_RECORDER", "recorder_for",
     "telemetry_enabled", "gteps", "engine_label",
     "note_compile_seconds", "consume_compile_seconds",

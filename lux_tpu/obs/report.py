@@ -21,12 +21,67 @@ from . import metrics
 _LOG_ROWS_HEAD = 24
 _LOG_ROWS_TAIL = 8
 
-# Roofline peaks. HBM matches bench.py's v5e single-chip figure; ICI is
-# the per-chip v5e interconnect estimate (4 links x ~46.5 GB/s usable).
-# Both are ceilings for *fractions* — the ledger labels results as
-# model-derived, not measured, on CPU meshes.
-HBM_PEAK_GBPS = 819.0
-ICI_PEAK_GBPS = 186.0
+# Roofline peak-rate registry, keyed on jax's device_kind. HBM rows are
+# the public per-chip HBM bandwidths; ICI rows the per-chip interconnect
+# estimates (v5e: 4 links x ~46.5 GB/s usable). Both are ceilings for
+# *fractions* only. A CPU host has neither HBM nor ICI, so its row
+# deliberately prices nothing — and an UNKNOWN kind reports None plus a
+# one-time warning instead of silently assuming v5e (the pre-PR-15
+# behavior priced every chip against the v5e constants).
+_DEVICE_PROFILES = {
+    "TPU v5e": (819.0, 186.0),
+    "TPU v5 lite": (819.0, 186.0),     # v5e's device_kind on some stacks
+    "TPU v5p": (2765.0, 600.0),
+    "TPU v5": (2765.0, 600.0),
+    "TPU v4": (1228.0, 300.0),
+    "cpu": (None, None),
+    "Cpu": (None, None),
+}
+
+_kind_cache = []
+_warned_kinds = set()
+
+
+def _device_kind() -> str:
+    """``jax.devices()[0].device_kind``, cached; 'unknown' when no
+    backend is reachable (pure-host tools)."""
+    if not _kind_cache:
+        try:
+            import jax
+
+            _kind_cache.append(jax.devices()[0].device_kind)
+        except Exception:
+            _kind_cache.append("unknown")
+    return _kind_cache[0]
+
+
+def device_profile(kind: str = None) -> dict:
+    """The roofline peak-rate row for ``kind`` (default: the live
+    backend's device_kind): ``{device_kind, hbm_peak_gbps,
+    ici_peak_gbps, known}``. ``LUX_HBM_PEAK_GBPS`` /
+    ``LUX_ICI_PEAK_GBPS`` override either rate (e.g. a chip the
+    registry predates). An unknown kind without overrides yields None
+    peaks — roofline fractions then stay None rather than pricing
+    against the wrong chip — and warns once per kind."""
+    if kind is None:
+        kind = _device_kind()
+    row = _DEVICE_PROFILES.get(kind)
+    hbm, ici = row if row else (None, None)
+    hbm_env = flags.get("LUX_HBM_PEAK_GBPS")
+    ici_env = flags.get("LUX_ICI_PEAK_GBPS")
+    if hbm_env:
+        hbm = float(hbm_env)
+    if ici_env:
+        ici = float(ici_env)
+    if row is None and not (hbm_env or ici_env) \
+            and kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        get_logger("perf").warning(
+            "no device profile for device_kind=%r: roofline fractions "
+            "will be None (set LUX_HBM_PEAK_GBPS/LUX_ICI_PEAK_GBPS to "
+            "price this chip)", kind)
+    return {"device_kind": kind, "hbm_peak_gbps": hbm,
+            "ici_peak_gbps": ici, "known": row is not None}
 
 
 def roofline(summary: dict) -> dict:
@@ -41,13 +96,16 @@ def roofline(summary: dict) -> dict:
     collectives while the peak is per chip.
     """
     out = {}
+    prof_row = device_profile()
+    out["device_kind"] = prof_row["device_kind"]
     iters = summary.get("num_iters") or 0
     exec_s = summary.get("execute_s") or 0.0
     hbm = summary.get("hbm_bytes_per_iter")
     if hbm and iters and exec_s > 0:
         gbps = hbm * iters / exec_s / 1e9
         out["hbm_gbps"] = gbps
-        out["hbm_frac"] = gbps / HBM_PEAK_GBPS
+        peak = prof_row["hbm_peak_gbps"]
+        out["hbm_frac"] = gbps / peak if peak else None
     exch = summary.get("exchange_bytes_per_iter")
     if exch and iters:
         phases = summary.get("phases") or {}
@@ -56,7 +114,8 @@ def roofline(summary: dict) -> dict:
         if exch_s > 0:
             gbps = exch * iters / exch_s / 1e9 / max(parts, 1)
             out["ici_gbps_per_chip"] = gbps
-            out["ici_frac"] = gbps / ICI_PEAK_GBPS
+            peak = prof_row["ici_peak_gbps"]
+            out["ici_frac"] = gbps / peak if peak else None
             out["ici_measured"] = bool(phases)
     return out
 
@@ -82,14 +141,17 @@ def _format_table(summary: dict) -> str:
     roof = summary.get("roofline")
     if roof:
         bits = []
-        if "hbm_frac" in roof:
-            bits.append("HBM {hbm_gbps:.1f} GB/s ({hbm_frac:.3f} of "
-                        "peak)".format(**roof))
-        if "ici_frac" in roof:
-            bits.append("ICI {ici_gbps_per_chip:.1f} GB/s/chip "
-                        "({ici_frac:.3f} of peak{})".format(
-                            "" if roof.get("ici_measured")
-                            else ", bound", **roof))
+        if "hbm_gbps" in roof:
+            frac = roof.get("hbm_frac")
+            bits.append("HBM {:.1f} GB/s ({} of peak)".format(
+                roof["hbm_gbps"],
+                "n/a" if frac is None else f"{frac:.3f}"))
+        if "ici_gbps_per_chip" in roof:
+            frac = roof.get("ici_frac")
+            bits.append("ICI {:.1f} GB/s/chip ({} of peak{})".format(
+                roof["ici_gbps_per_chip"],
+                "n/a" if frac is None else f"{frac:.3f}",
+                "" if roof.get("ici_measured") else ", bound"))
         if bits:
             lines.append("  roofline: " + "; ".join(bits))
     rows = summary.get("iterations") or []

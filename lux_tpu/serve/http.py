@@ -30,6 +30,10 @@ Endpoints:
   ``"queue": true`` to durably enqueue behind the WAL without swapping,
   or send ``{"flush": true}`` alone to fold the queue / retry an
   aborted swap (serve/session.py ``enqueue_edits``/``flush_edits``).
+- ``POST /profilez`` — body ``{"steps": N}``: run a programmatic
+  device-timeline capture window (obs/prof.py) over N engine steps and
+  return the parsed ``profile.v1`` report. 403 unless ``LUX_PROF_DIR``
+  is set; 429 while another capture is in flight.
 
 Every JSON response carries ``X-Lux-Snapshot: <serving version>`` so
 clients can observe a hot-swap from response headers alone, and is
@@ -42,7 +46,8 @@ the circuit breaker's cooldown remainder (serve/breaker.py).
 Every ``POST /query`` runs under a root request span (obs/spans.py):
 the response carries the trace-id in ``X-Lux-Trace``, and the same id
 keys the request's async lane in the Chrome trace. ``SIGUSR1`` (CLI
-mode) dumps a flight.v1 postmortem to ``LUX_FLIGHT_DIR``.
+mode) dumps a flight.v1 postmortem to ``LUX_FLIGHT_DIR``; ``SIGUSR2``
+toggles a profiler capture window under ``LUX_PROF_DIR``.
 
 Error mapping: ``BadQueryError`` → 400, ``QueueFullError`` → 429,
 ``DeadlineExceededError`` → 504 (serve/errors.py owns the taxonomy).
@@ -62,7 +67,7 @@ from typing import Optional
 
 import numpy as np
 
-from lux_tpu.obs import flight, metrics, spans
+from lux_tpu.obs import flight, metrics, prof, spans
 from lux_tpu.serve.errors import ServeError, BadQueryError
 from lux_tpu.serve.session import ServeConfig, Session
 from lux_tpu.utils import flags
@@ -204,6 +209,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/snapshot":
             self._post_snapshot()
             return
+        if self.path == "/profilez":
+            self._post_profilez()
+            return
         if self.path != "/query":
             self._reply(404, {"error": f"no such endpoint {self.path}"})
             return
@@ -283,6 +291,42 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(500, {"error": str(e),
                                   "kind": type(e).__name__}, trace_id=tid)
 
+    def _post_profilez(self):
+        """``POST /profilez {"steps": N}`` — programmatic capture
+        window: N engine steps under ``jax.profiler.trace``, parsed into
+        the ``profile.v1`` report returned as the response body. Guarded:
+        403 when ``LUX_PROF_DIR`` is unset (profiling unarmed — captures
+        must be an explicit operator decision, not a default-on endpoint
+        anyone can hit), 429 when a capture is already in flight (one
+        window at a time; concurrent queries keep serving either way)."""
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise BadQueryError("body must be a JSON object")
+            if not flags.get("LUX_PROF_DIR"):
+                self._reply(403, {
+                    "error": "profiling unarmed: set LUX_PROF_DIR",
+                    "kind": "ProfilingDisabled"})
+                return
+            try:
+                steps = int(body.get("steps", 8))
+            except (TypeError, ValueError):
+                raise BadQueryError("'steps' must be an integer")
+            rep = self.session.profile_capture(steps)
+            self._reply(200, rep)
+        except prof.CaptureBusyError as e:
+            self._reply(429, {"error": str(e), "kind": "CaptureBusyError"},
+                        retry_after=1.0)
+        except BadQueryError as e:
+            self._reply(400, {"error": str(e), "kind": "BadQueryError"})
+        except json.JSONDecodeError as e:
+            self._reply(400, {"error": f"bad JSON: {e}",
+                              "kind": "BadQueryError"})
+        except Exception as e:   # capture bug: surface, keep serving
+            self._reply(500, {"error": str(e),
+                              "kind": type(e).__name__})
+
     # query() futures raise ServeError subclasses; unwrap happens via
     # Future.result() re-raising them directly, so do_POST's except
     # clauses see the original types.
@@ -346,6 +390,9 @@ def main(argv: Optional[list] = None) -> int:
     if flight.install_signal_handler():
         log.info("SIGUSR1 -> flight.v1 postmortem (LUX_FLIGHT_DIR=%s)",
                  flags.get("LUX_FLIGHT_DIR"))
+    if prof.install_signal_handler():
+        log.info("SIGUSR2 -> profiler capture toggle (LUX_PROF_DIR=%s)",
+                 flags.get("LUX_PROF_DIR"))
     log.info(
         "serving %s (nv=%d ne=%d) on http://%s:%d  "
         "[max_batch=%d window=%.1fms queue=%d mesh=%s]",

@@ -42,7 +42,7 @@ import numpy as np
 
 from lux_tpu.graph.graph import Graph
 from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
-from lux_tpu.obs import engobs, flight, metrics, slo, spans
+from lux_tpu.obs import engobs, flight, metrics, prof, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
 from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
@@ -620,7 +620,8 @@ class Session:
             try:
                 with self._watched(key):
                     faults.point("serve.engine.execute")
-                    out = fn()
+                    with prof.region("lux.serve.execute"):
+                        out = fn()
             except ServeError:
                 raise             # shed/typed errors are not engine faults
             except Exception as e:
@@ -1256,8 +1257,65 @@ class Session:
             # Latest engine-observatory telemetry per engine: phase
             # split, useful-bytes ratio, frontier density ({} until an
             # instrumented run has happened in this process).
-            "engobs": engobs.latest(),
+            "engobs": self._engobs_block(),
         }
+
+    @staticmethod
+    def _engobs_block() -> dict:
+        """engobs.latest() with the overlap number labeled for what it
+        is: ``exchange_hidden_frac`` is a host-clock *budget* (an upper
+        bound — phase fencing serializes the overlap it prices), so each
+        record carries a note saying so, plus the device-measured
+        ``realized_hidden_frac`` from the latest profile.v1 capture when
+        one exists in this process."""
+        realized = prof.latest_realized()
+        out = {}
+        for kind, rec in engobs.latest().items():
+            rec = dict(rec)
+            if "exchange_hidden_frac" in rec \
+                    or "run_exchange_hidden_frac" in rec:
+                rec["exchange_hidden_frac_note"] = "budget (upper bound)"
+                if realized is not None:
+                    rec["realized_hidden_frac"] = realized
+            out[kind] = rec
+        return out
+
+    def profile_capture(self, steps: int = 8) -> dict:
+        """Run a programmatic device-timeline capture window (the
+        ``POST /profilez`` handler): ``steps`` fused PageRank steps on
+        the serving engine under ``jax.profiler.trace``, parsed into a
+        ``profile.v1`` report. Requires ``LUX_PROF_DIR``; raises
+        ``prof.CaptureBusyError`` when a capture is already running."""
+        from lux_tpu.engine.pull_sharded import hard_sync
+
+        steps = max(1, min(int(steps), 64))
+        ex = self._pagerank_engine()
+        ex.warmup()
+        vals = ex.init_values()
+        op_maps = []
+        step = getattr(ex, "_step", None)
+        dg = getattr(ex, "_device_graph", None)
+        if step is not None and dg is not None:
+            # The AOT lowering below costs one backend compile — an
+            # expect window budgets it so the serving zero-recompile
+            # contract (pool recompile counters) stays clean.
+            try:
+                with self.pool.sentinel.expect(("profilez", "opmap")):
+                    op_maps.append(prof.op_map_for(step, vals, dg))
+            # A failed op-map build degrades to an untagged (still
+            # valid) report; the capture must not fail over it.
+            # luxlint: disable=LUX007 -- degraded capture is the outcome
+            except Exception as e:
+                self.log.warning("profile op-map build failed: %r", e)
+
+        def drive():
+            v = vals
+            for _ in range(steps):
+                v = ex.step(v)
+            return hard_sync(v)
+
+        _, rep = prof.profile_window(drive, steps=steps, op_maps=op_maps)
+        return rep
 
     def mesh_exchange_bytes(self) -> dict:
         """Per-app dense-estimate exchange bytes per iteration for the

@@ -165,6 +165,17 @@ define("LUX_ENGOBS", False,
        "executors through phase-fenced steps splitting exchange vs "
        "compute time per iteration; off keeps the exact fused programs",
        kind="bool")
+define("LUX_PROF_DIR", None,
+       "arm the device-timeline profiler (obs/prof.py): capture windows "
+       "(bench --profile, POST /profilez, SIGUSR2 toggle) write "
+       "TensorBoard artifacts + profile.v1 reports under this directory",
+       kind="path")
+define("LUX_HBM_PEAK_GBPS", None,
+       "override the roofline HBM peak (GB/s) when the device-profile "
+       "registry (obs/report.py) has no row for this device_kind")
+define("LUX_ICI_PEAK_GBPS", None,
+       "override the roofline per-chip ICI peak (GB/s) when the "
+       "device-profile registry has no row for this device_kind")
 
 # Backend / native toolchain (utils/platform.py, native/build.py)
 define("LUX_PLATFORM", None,

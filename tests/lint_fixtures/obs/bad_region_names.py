@@ -1,0 +1,34 @@
+"""LUX009 fixtures: profiler region names breaking the naming
+contract. Literal names passed to prof.region / jax.named_scope /
+jax.profiler.TraceAnnotation must fullmatch lux.[a-z0-9_.]+ — anything
+else never joins the profile.v1 phase accounting and the time it
+brackets silently vanishes from exchange/compute attribution."""
+import jax
+
+from lux_tpu.obs import prof
+from lux_tpu.obs.prof import region
+
+
+def missing_prefix(fn):
+    with prof.region("pull.exchange"):  # expect: LUX009
+        return fn()
+
+
+def wrong_case(fn):
+    with prof.region("lux.Pull.Exchange"):  # expect: LUX009
+        return fn()
+
+
+def bare_import(fn):
+    with region("exchange"):  # expect: LUX009
+        return fn()
+
+
+def raw_named_scope(fn):
+    with jax.named_scope("my scope"):  # expect: LUX009
+        return fn()
+
+
+def raw_annotation(fn):
+    with jax.profiler.TraceAnnotation("Step-1"):  # expect: LUX009
+        return fn()

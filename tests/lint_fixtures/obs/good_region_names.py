@@ -1,0 +1,40 @@
+"""LUX009 negative fixtures: compliant or out-of-scope region names —
+zero findings expected."""
+import jax
+
+from lux_tpu.obs import prof
+from lux_tpu.obs.prof import region
+
+
+def compliant(fn):
+    with prof.region("lux.pull_sharded.exchange"):
+        return fn()
+
+
+def compliant_bare(fn):
+    with region("lux.serve.execute"):
+        return fn()
+
+
+def compliant_scope(fn):
+    with jax.named_scope("lux.tiled.compute_0"):
+        return fn()
+
+
+def dynamic_name(fn, tag):
+    # Non-literal names validate at runtime (prof.region raises on a
+    # bad name); the static rule only judges literals.
+    with prof.region(tag):
+        return fn()
+
+
+def unrelated_region(fn):
+    # Some other library's `region` — not the prof one; out of scope.
+    class _Tracer:
+        def region(self, name):
+            import contextlib
+
+            return contextlib.nullcontext()
+
+    with _Tracer().region("whatever"):
+        return fn()

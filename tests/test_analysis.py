@@ -35,6 +35,7 @@ BAD_FIXTURES = (
     "serve/bad_clock.py",
     "serve/bad_swallow.py",
     "obs/bad_metric_names.py",
+    "obs/bad_region_names.py",
 )
 GOOD_FIXTURES = (
     "engine/good_host_sync.py",
@@ -44,6 +45,7 @@ GOOD_FIXTURES = (
     "serve/good_clock.py",
     "serve/good_swallow.py",
     "obs/good_metric_names.py",
+    "obs/good_region_names.py",
 )
 
 

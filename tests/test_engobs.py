@@ -160,7 +160,7 @@ def test_engobs_off_is_default_fused_path_with_zero_recompiles(monkeypatch):
 
 def _doc(metrics_map, **ctx):
     context = {"mode": "fast", "scale": 10, "ef": 8, "layout": "tiled",
-               "platform": "cpu"}
+               "platform": "cpu", "exchange": "full", "device_kind": "cpu"}
     context.update(ctx)
     return {"schema": "bench_gate.v1", "mode": context["mode"],
             "context": context, "cmd": "test", "metrics": metrics_map}

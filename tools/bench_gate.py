@@ -12,7 +12,8 @@ Usage:
 ``--fast`` runs the suite on a tiny graph (LUX_BENCH_GATE_SCALE,
 default 10) so the gate fits in `make verify`; full mode uses the
 bench defaults (scale 22). Rounds only compare against baselines with
-the same context (mode, scale, edge factor, layout, platform) — the
+the same context (mode, scale, edge factor, layout, platform,
+device_kind) — the
 r01-r05 full-scale TPU artifacts are kept as history, not gates, for a
 fast CPU round. ``--replay`` feeds a previously-emitted bench_gate.v1
 JSON through the comparison (no bench run) — the seeded-regression test
@@ -40,7 +41,8 @@ from lux_tpu.utils import flags  # noqa: E402
 
 _LOWER_IS_BETTER = re.compile(r"(_ms_per_iter|ms_per_iter|_seconds|_s)$")
 # Context keys that must match for two rounds to be comparable.
-_CONTEXT_KEYS = ("mode", "scale", "ef", "layout", "platform", "exchange")
+_CONTEXT_KEYS = ("mode", "scale", "ef", "layout", "platform", "exchange",
+                 "device_kind")
 
 
 def log(msg):
@@ -133,6 +135,17 @@ def comparable(cur_ctx: dict, base_ctx: dict):
             # Baselines recorded before the exchange key existed ran
             # under the then-only full exchange.
             b = flags.default("LUX_EXCHANGE")
+        if key == "device_kind" and b is None:
+            # A baseline that never recorded its chip could have come
+            # from ANY device; numbers from different chips are
+            # different experiments, so fail closed rather than ratchet
+            # a v5e round against (say) a v5p artifact — unless both
+            # sides already agree on platform=cpu, where the kind is
+            # the platform.
+            if cur_ctx.get("platform") == "cpu" \
+                    and base_ctx.get("platform") == "cpu":
+                continue
+            return False, "baseline has no device_kind context"
         if b is None and key in ("ef", "platform", "mode"):
             if key == "mode" and cur_ctx.get("mode") == "fast":
                 return False, "legacy baseline has no fast-mode context"
@@ -199,6 +212,7 @@ def run_bench(fast: bool):
     if headline is None:
         raise SystemExit("bench.py printed no JSON headline")
     m = re.search(r"^# platform: (\S+)", proc.stderr, re.M)
+    mk = re.search(r"^# device_kind: (.+)$", proc.stderr, re.M)
     context = {
         "mode": "fast" if fast else "full",
         "scale": int(env.get("LUX_BENCH_SCALE",
@@ -211,6 +225,9 @@ def run_bench(fast: bool):
         # must never ratchet against each other silently.
         "exchange": env.get("LUX_EXCHANGE", flags.default("LUX_EXCHANGE")),
         "platform": m.group(1) if m else "unknown",
+        # The chip the numbers came from (jax device_kind); rounds from
+        # different chips never ratchet against each other.
+        "device_kind": mk.group(1).strip() if mk else "unknown",
     }
     return headline, context, " ".join(cmd)
 

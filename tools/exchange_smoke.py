@@ -195,8 +195,21 @@ def main() -> int:
             assert 0.0 <= frac <= 1.0, (name, frac)
         doc["exchange_hidden_frac"] = {
             k: round(v, 3) for k, v in hidden.items()}
+        # The key stays `exchange_hidden_frac` for artifact
+        # compatibility, but it is a BUDGET (upper bound): phase fencing
+        # serializes the overlap it prices. The device-measured number
+        # is `realized_hidden_frac` from a profile.v1 capture
+        # (obs/prof.py) — surfaced next to the budget when one exists.
+        doc["exchange_hidden_frac_note"] = "budget (upper bound)"
+        from lux_tpu.obs import prof
+
+        realized = prof.latest_realized()
+        if realized is not None:
+            doc["realized_hidden_frac"] = round(realized, 3)
         log(f"engobs: exchange_hidden_frac={doc['exchange_hidden_frac']} "
-            "(overlap budget; phase fencing serializes the real overlap)")
+            "— budget (upper bound); device-measured realized_hidden_frac"
+            f"={realized if realized is not None else 'n/a (no profile)'}"
+            " via obs/prof.py capture windows")
     finally:
         del os.environ["LUX_ENGOBS"]
         del os.environ["LUX_EXCHANGE"]

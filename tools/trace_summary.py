@@ -7,6 +7,13 @@ Usage:
   python tools/trace_summary.py TRACE.jsonl --phases   # p50/p95 + phase split
   python tools/trace_summary.py TRACE.jsonl --to-chrome out.json
   python tools/trace_summary.py METRICS.jsonl          # run summary mode
+  python tools/trace_summary.py profile_v1.json --phases  # device timeline
+
+``--phases`` also accepts a ``profile.v1`` report (a capture window's
+``profile_v1.json``, obs/prof.py): the host-span phase split above is a
+wall-clock view; the profile.v1 table is the device-measured one
+(interval unions, realized_hidden_frac), rendered via the same
+formatter as tools/prof_summary.py.
 
 Self time = span duration minus the duration of spans nested inside it
 on the same (pid, tid) track, so a run-level span does not dwarf the
@@ -201,6 +208,26 @@ def main(argv=None):
                     help="per-span p50/p95 table plus the exchange/compute "
                     "phase split and counter series (engine observatory)")
     args = ap.parse_args(argv)
+
+    # A profile.v1 report is one JSON document, not JSON-lines — detect
+    # it first (obs/prof.py capture windows write profile_v1.json).
+    try:
+        with open(args.path) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        doc = None
+    if isinstance(doc, dict) and doc.get("schema") == "profile.v1":
+        if not args.phases:
+            raise SystemExit("profile.v1 reports need --phases "
+                             "(or use tools/prof_summary.py)")
+        import os
+
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from lux_tpu.obs import prof
+
+        print(prof.format_report(prof.validate(doc)))
+        return 0
 
     events = read_jsonl(args.path)
     if not events:

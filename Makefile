@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -33,7 +33,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -90,6 +90,14 @@ exchange-smoke:
 # concurrent burst, /statusz budget labeling.
 prof-smoke:
 	python tools/prof_smoke.py
+
+# Observability-ledger acceptance: two-tenant warm HTTP burst with
+# LUX_LEDGER_DIR armed — X-Lux-Cost on every reply, /costz totals equal
+# to the lux_query_cost_* metric values, crc-clean runrec.v1 records
+# whose config_hash reproduces, a CLEAN lux_doctor verdict, zero
+# recompiles.
+ledger-smoke:
+	env JAX_PLATFORMS=cpu python tools/ledger_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

@@ -71,7 +71,9 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from lux_tpu.obs import IterationRecorder, gteps as lux_gteps  # noqa: E402
+from lux_tpu.obs import (  # noqa: E402
+    IterationRecorder, gteps as lux_gteps, ledger,
+)
 
 BASELINE_GTEPS = 10.0      # assumed 8xV100 Twitter-2010 PageRank (see above)
 PER_CHIP_BASELINE = BASELINE_GTEPS / 8.0
@@ -403,6 +405,24 @@ def main():
     # (pagerank/pagerank.cc:115-118).
     print(json.dumps(out), flush=True)
 
+    # Durable evidence: the headline as one runrec.v1 observation (the
+    # A/B corpus tools/lux_doctor.py attributes regressions from). The
+    # headline recorder goes through summary(), not finish(), so the
+    # report.finalize feed-in never fires for it — this is its only
+    # ledger entry. rmat{scale}_{ef} is a deterministic seeded graph, a
+    # faithful fingerprint.
+    tel = head.get("telemetry") or {}
+    ledger.record_run(
+        "bench_headline",
+        {"gteps": head["gteps"], "achieved_gbps": head["achieved_gbps"],
+         "hbm_peak_frac": head["hbm_peak_frac"],
+         "compile_s": tel.get("compile_s"),
+         "execute_s": tel.get("execute_s"),
+         "nv": int(g.nv), "ne": int(g.ne)},
+        graph_fingerprint=f"rmat{scale}_{ef}",
+        program="PageRank", engine_kind=layout,
+    )
+
     if run_suite:
         suite = {}
 
@@ -421,6 +441,13 @@ def main():
                 # the headline (and in LUX_METRICS dumps when set).
                 res.pop("telemetry", None)
                 suite[name] = res
+                ledger.record_run(
+                    "bench_suite",
+                    {k: v for k, v in res.items()
+                     if isinstance(v, (int, float))},
+                    graph_fingerprint=f"suite-rmat{scale}_{ef}",
+                    program=name, engine_kind=layout,
+                )
             except SkipItem as e:
                 log(f"suite[{name}] skipped: {e}")
                 suite[name] = {"skipped": str(e)}

@@ -766,6 +766,52 @@ class RegionNameDiscipline(Rule):
         return out
 
 
+class LedgerDiscipline(Rule):
+    id = "LUX010"
+    title = "ledger-discipline"
+    doc = ("run metrics (summaries, telemetry) leave the process through "
+           "the run ledger (lux_tpu/obs/ledger.py record_run), not ad-hoc "
+           "json.dump — an unframed dump is invisible to lux_doctor and "
+           "the auto-tuner corpus, and carries no config_hash to "
+           "reproduce it under")
+
+    # Dumping an expression rooted at one of these identifiers is the
+    # run-metrics shape this rule polices; artifact writes (plans,
+    # reports, flight docs, bench round lines) keep their own formats.
+    _METRIC_IDENTS = ("summary", "telemetry", "runrec", "run_record",
+                      "metrics")
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        p = ctx.posix_path
+        if p.endswith("obs/ledger.py") or p.endswith("obs/report.py"):
+            # The ledger's own framing, and the documented legacy
+            # LUX_METRICS JSON-lines dump report.finalize still feeds.
+            return False
+        return ("engine/" in p or "serve/" in p or "obs/" in p
+                or p.endswith("bench.py"))
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func) or ""
+            if name not in ("json.dump", "json.dumps"):
+                continue
+            arg = node.args[0] if node.args else None
+            root = (_root_ident(arg) or "").lower() if arg is not None \
+                else ""
+            if any(tok in root for tok in self._METRIC_IDENTS):
+                out.append(self.finding(
+                    ctx, node,
+                    f"ad-hoc json dump of run metrics ({root!r}) — append "
+                    "a runrec.v1 record via lux_tpu.obs.ledger.record_run "
+                    "so the observation is durable, crc-framed, and keyed "
+                    "by (graph, program, engine, mesh, config_hash)",
+                ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         HostSyncInHotLoop(),
@@ -777,4 +823,5 @@ def all_rules() -> List[Rule]:
         SwallowedException(),
         MetricNameDiscipline(),
         RegionNameDiscipline(),
+        LedgerDiscipline(),
     ]

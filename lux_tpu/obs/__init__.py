@@ -24,7 +24,7 @@ Environment knobs (all optional; everything is a no-op when unset):
 """
 
 from ..utils import logging as _logging
-from . import flight, metrics, prof, report, slo, spans, trace
+from . import flight, ledger, metrics, prof, report, slo, spans, trace
 from .iterlog import (
     NULL_RECORDER,
     IterationRecorder,
@@ -38,6 +38,7 @@ from .iterlog import (
 
 __all__ = [
     "metrics", "trace", "report", "spans", "flight", "slo", "prof",
+    "ledger",
     "IterationRecorder", "NULL_RECORDER", "recorder_for",
     "telemetry_enabled", "gteps", "engine_label",
     "note_compile_seconds", "consume_compile_seconds",

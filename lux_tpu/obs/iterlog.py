@@ -23,7 +23,7 @@ from __future__ import annotations
 import time
 
 from ..utils import flags
-from . import engobs, flight, metrics, trace
+from . import engobs, flight, ledger, metrics, trace
 from .spans import SPAN_BUCKETS
 
 
@@ -81,9 +81,10 @@ def telemetry_enabled() -> bool:
     # The flight recorder needs iteration records flowing even with no
     # metrics path / trace writer: an armed LUX_FLIGHT_DIR turns the
     # recorders on so in-flight sweeps appear in postmortems. Likewise
-    # LUX_ENGOBS: a phase-fenced run exists to be recorded.
+    # LUX_ENGOBS: a phase-fenced run exists to be recorded. And an armed
+    # run ledger: every run must land a runrec.v1 observation.
     return bool(flags.get("LUX_METRICS")) or trace.enabled() \
-        or flight.enabled() or engobs.enabled()
+        or flight.enabled() or engobs.enabled() or ledger.enabled()
 
 
 def recorder_for(engine: str, graph, program=None):

@@ -14,7 +14,7 @@ import json
 
 from ..utils import flags
 from ..utils.logging import get_logger
-from . import metrics
+from . import ledger, metrics
 
 # Cap the per-iteration rows logged to lux.perf; the JSON dump always
 # carries every record.
@@ -186,6 +186,18 @@ def finalize(summary: dict):
         summary["roofline"] = roof
     log = get_logger("perf")
     log.info("%s", _format_table(summary))
+    # Every finished run becomes one durable runrec.v1 observation when
+    # the ledger is armed — this is THE engine-run feed-in point: every
+    # executor that runs through IterationRecorder.finish() lands here.
+    # Per-iteration rows stay in the LUX_METRICS dump; the ledger keeps
+    # the (config -> aggregate metrics) observation compact.
+    obs = {k: v for k, v in summary.items() if k != "iterations"}
+    ledger.record_run(
+        "engine_run", obs,
+        program=str(summary.get("program", "?")),
+        engine_kind=str(summary.get("engine", "?")),
+        mesh_shape=str(summary.get("parts", 1)),
+    )
     path = flags.get("LUX_METRICS")
     if not path:
         return

@@ -49,6 +49,10 @@ class Request:
     # Captured at construction on the admitting thread, so the batcher
     # worker can continue the request's trace (spans.adopt).
     trace_id: Optional[str] = field(default_factory=spans.current_trace_id)
+    # Per-query cost record (serve/cost.py), filled by the executor
+    # callback before the future resolves; None for internal requests
+    # (hot-swap barriers) and cost-unaware callers.
+    cost: Any = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -19,6 +19,12 @@ Endpoints:
 - ``GET /statusz`` — rolling 1-min/5-min SLO windows (p50/p95/p99 per
   app), queue depth, cache hit rate, batch-width histogram, shed and
   recompile counters (JSON; windows set by ``LUX_STATUSZ_WINDOWS``).
+- ``GET /costz`` — per-tenant cost accounting (serve/cost.py):
+  cumulative totals (requests, engine seconds, exchange bytes,
+  iterations, hit/miss) plus rolling engine-seconds quantiles per
+  ``LUX_STATUSZ_WINDOWS`` window. Tenancy comes from the
+  ``X-Lux-Tenant`` request header on ``POST /query`` (default tenant
+  otherwise); each query's own spend comes back in ``X-Lux-Cost``.
 - ``GET /snapshot`` — the serving snapshot version, fingerprint, delta
   ratio, and the store's version history.
 - ``POST /snapshot`` — admin edit endpoint: body
@@ -137,7 +143,8 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def _reply(self, status: int, payload: dict,
-               trace_id: str = None, retry_after: float = None):
+               trace_id: str = None, retry_after: float = None,
+               cost: str = None):
         body = json.dumps(payload).encode()
         # Counted HERE and only here, so every terminal status — success,
         # shed, breaker-open, handler bug — lands in one per-code series
@@ -148,6 +155,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         if trace_id:
             self.send_header("X-Lux-Trace", trace_id)
+        if cost:
+            # What this query spent (serve/cost.py): tenant, cache
+            # outcome, iterations, engine seconds, exchange bytes.
+            self.send_header("X-Lux-Cost", cost)
         if retry_after is not None:
             # Shed responses (429/503/504) tell clients when to come
             # back instead of letting them hammer a known-bad window.
@@ -196,6 +207,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, s.stats())
         elif self.path == "/statusz":
             self._reply(200, s.statusz())
+        elif self.path == "/costz":
+            self._reply(200, s.costz())
         elif self.path == "/metrics":
             self._reply_text(200, metrics.render_prometheus())
         elif self.path == "/metrics.json":
@@ -229,12 +242,16 @@ class _Handler(BaseHTTPRequestHandler):
                     k: v for k, v in body.items()
                     if k in ("start", "ni", "k")
                 }
-                result = self.session.query(
-                    app, deadline_s=body.get("deadline_s"), **params
+                fut = self.session.submit(
+                    app, deadline_s=body.get("deadline_s"),
+                    tenant=self.headers.get("X-Lux-Tenant"), **params
                 )
+                result = fut.result()
+                qc = getattr(fut, "_lux_cost", None)
                 self._reply(
                     200, render_result(result, body, self.session.graph.nv),
                     trace_id=tid,
+                    cost=qc.header() if qc is not None else None,
                 )
             except ServeError as e:
                 self._reply(e.http_status, {

@@ -42,8 +42,9 @@ import numpy as np
 
 from lux_tpu.graph.graph import Graph
 from lux_tpu.graph.snapshot import Snapshot, SnapshotStore
-from lux_tpu.obs import engobs, flight, metrics, prof, slo, spans
+from lux_tpu.obs import engobs, flight, ledger, metrics, prof, slo, spans
 from lux_tpu.serve.batcher import MicroBatcher, Request
+from lux_tpu.serve.cost import CostAccounts, QueryCost
 from lux_tpu.serve.breaker import CircuitBreaker
 from lux_tpu.serve.cache import ResultCache
 from lux_tpu.serve.errors import (BadQueryError, QueueFullError,
@@ -144,6 +145,7 @@ class Session:
         self._requests = metrics.counter("lux_serve_requests_total")
         self._latency = metrics.histogram("lux_serve_request_seconds")
         self.slo = slo.SloWindows()
+        self.costs = CostAccounts()
         self._served_keys = set()   # batcher-thread only
         self._closed = False
         self._flight_name = f"session:{self.fingerprint[:12]}"
@@ -383,6 +385,7 @@ class Session:
         pool miss counter is the recompile count: the smoke test asserts
         it stays flat across the query phase."""
         snap = snap or self._serving
+        t_warm0 = spans.clock()
         with spans.span("serve.warmup", version=snap.version):
             faults.point("snapshot.warm")
             with _timed(self.log, "warmup sssp single"):
@@ -405,6 +408,20 @@ class Session:
                 extra = (2,) if app == "kcore" else ()
                 with _timed(self.log, f"warmup {app} gas"):
                     self._gas_single(app, snap, extra=extra)
+        # One durable observation per warmed snapshot: what this config
+        # paid to get every served engine compiled and resident.
+        ledger.record_run(
+            "serve_warmup",
+            {"warm_s": spans.clock() - t_warm0, "version": snap.version,
+             "nv": int(snap.graph.nv), "ne": int(snap.graph.ne),
+             "apps": list(self.APPS),
+             "pool": self.pool.stats()},
+            graph_fingerprint=snap.fingerprint, program="serve",
+            engine_kind="warmup", mesh_shape=self._mesh_label(),
+        )
+
+    def _mesh_label(self) -> str:
+        return "x".join(map(str, self.meshspec.shape))
 
     # -- query front door ------------------------------------------------
 
@@ -412,13 +429,15 @@ class Session:
         self,
         app: str,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
         **params,
     ) -> Future:
         """Admit one query; returns a Future resolving to a dict with at
         least ``values`` (np.ndarray) and ``iters``. Raises
         ``BadQueryError`` on malformed queries and ``QueueFullError``
         under overload; the Future raises ``DeadlineExceededError`` when
-        shed."""
+        shed. ``tenant`` labels the query's cost record (X-Lux-Tenant
+        upstream; unlabeled traffic books to the default tenant)."""
         if self._closed:
             raise BadQueryError("session is closed")
         app = str(app)
@@ -426,6 +445,7 @@ class Session:
             raise BadQueryError(
                 f"unknown app {app!r}; serving {list(self.APPS)}"
             )
+        cost = QueryCost(tenant, app)
         self._requests.inc()
         metrics.counter(
             "lux_serve_requests_total", {"app": app}
@@ -455,12 +475,12 @@ class Session:
             # to be failing (503 + Retry-After upstream).
             self.breaker.check((app, snap.fingerprint))
             if app == "sssp":
-                fut = self._submit_sssp(params, deadline, snap)
+                fut = self._submit_sssp(params, deadline, snap, cost)
             elif app == "components":
                 fut = self._submit_cached_fixpoint(
                     app, ("components",),
                     lambda dl=None: self._run_components(snap, dl),
-                    deadline, snap,
+                    deadline, snap, cost,
                 )
             elif app == "pagerank":
                 ni = int(params.get("ni", self.config.pagerank_iters))
@@ -471,10 +491,11 @@ class Session:
                 fut = self._submit_cached_fixpoint(
                     app, ("pagerank", ni),
                     lambda dl=None: self._run_pagerank(ni, snap, dl),
-                    deadline, snap,
+                    deadline, snap, cost,
                 )
             elif app in self._gas_rooted:
-                fut = self._submit_rooted_gas(app, params, deadline, snap)
+                fut = self._submit_rooted_gas(app, params, deadline, snap,
+                                              cost)
             elif app == "kcore":
                 try:
                     k = int(params.get("k", 2))
@@ -488,14 +509,14 @@ class Session:
                     app, ("kcore", k),
                     lambda dl=None: self._run_gas_fixpoint(
                         app, snap, dl, extra=(k,)),
-                    deadline, snap,
+                    deadline, snap, cost,
                 )
             else:
                 # Remaining registry-derived fixpoints (labelprop today).
                 fut = self._submit_cached_fixpoint(
                     app, (app,),
                     lambda dl=None: self._run_gas_fixpoint(app, snap, dl),
-                    deadline, snap,
+                    deadline, snap, cost,
                 )
         except BaseException:
             if token is not None:
@@ -506,13 +527,20 @@ class Session:
         if token is not None:
             spans.deactivate(token)
 
-        def _done(f, app=app, t0=t0, finish=finish):
+        def _done(f, app=app, t0=t0, finish=finish, cost=cost):
             dt = spans.clock() - t0
             self._latency.observe(dt)
             self.slo.observe(app, dt)
+            # The batcher thread finished filling the cost record before
+            # it resolved the future; book it to the tenant now (shed or
+            # failed queries still consumed admission — they book their
+            # accumulated, possibly zero, engine spend).
+            cost.latency_s = dt
+            self.costs.observe(cost)
             if finish is not None:
                 finish()
 
+        fut._lux_cost = cost   # readers: HTTP front door (X-Lux-Cost)
         fut.add_done_callback(_done)
         return fut
 
@@ -520,7 +548,8 @@ class Session:
         """Synchronous ``submit``; blocks for the result."""
         return self.submit(app, **params).result(timeout=timeout)
 
-    def _submit_sssp(self, params: dict, deadline, snap: Snapshot) -> Future:
+    def _submit_sssp(self, params: dict, deadline, snap: Snapshot,
+                     cost: QueryCost) -> Future:
         try:
             start = int(params["start"])
         except (KeyError, TypeError, ValueError):
@@ -533,6 +562,7 @@ class Session:
         key = (snap.fingerprint, "sssp", start)
         hit = self.cache.get(key)
         if hit is not None:
+            cost.outcome = "hit"     # zero engine spend: the cache paid
             fut: Future = Future()
             fut.set_result(hit)
             return fut
@@ -541,12 +571,12 @@ class Session:
         req = Request(
             app="sssp", payload=(snap, start),
             batch_key=("sssp", snap.fingerprint, self.config.max_batch),
-            deadline=deadline,
+            deadline=deadline, cost=cost,
         )
         return self.batcher.submit(req)
 
     def _submit_rooted_gas(self, app: str, params: dict, deadline,
-                           snap: Snapshot) -> Future:
+                           snap: Snapshot, cost: QueryCost) -> Future:
         """Rooted GAS apps (bfs, sssp_delta) ride the same micro-batch
         machinery as sssp: per-root result cache, fingerprinted batch
         key, K-lane dense sweep when a window coalesces."""
@@ -562,26 +592,28 @@ class Session:
         key = (snap.fingerprint, app, start)
         hit = self.cache.get(key)
         if hit is not None:
+            cost.outcome = "hit"
             fut: Future = Future()
             fut.set_result(hit)
             return fut
         req = Request(
             app=app, payload=(snap, start),
             batch_key=(app, snap.fingerprint, self.config.max_batch),
-            deadline=deadline,
+            deadline=deadline, cost=cost,
         )
         return self.batcher.submit(req)
 
     def _submit_cached_fixpoint(self, app, key_tail, run, deadline,
-                                snap: Snapshot) -> Future:
+                                snap: Snapshot, cost: QueryCost) -> Future:
         key = (snap.fingerprint,) + tuple(key_tail)
         hit = self.cache.get(key)
         if hit is not None:
+            cost.outcome = "hit"
             fut: Future = Future()
             fut.set_result(hit)
             return fut
         req = Request(app=app, payload=(key, run), batch_key=None,
-                      deadline=deadline)
+                      deadline=deadline, cost=cost)
         return self.batcher.submit(req)
 
     # -- batcher executor callback ---------------------------------------
@@ -643,6 +675,31 @@ class Session:
                 self.breaker.record_success(bkey)
                 return out
 
+    def _charge_batch(self, batch: List[Request], ex, iters: int,
+                      engine_s: float, switches: int = 0) -> None:
+        """Split one engine execution's cost evenly across the batch so
+        per-query charges sum to the batch totals (the /costz parity
+        invariant). Exchange bytes come from the sharded executor's
+        dense estimate; single-chip engines exchange nothing."""
+        n = max(1, len(batch))
+        exch_total = 0
+        fn = getattr(ex, "exchange_bytes_per_iter", None)
+        if fn is not None:
+            try:
+                exch_total = int(fn()) * int(iters)
+            except Exception:
+                exch_total = 0
+        for i, r in enumerate(batch):
+            if r.cost is None:
+                continue
+            # Integer bytes: the first member absorbs the remainder so
+            # the shares sum exactly to the total.
+            share = exch_total // n + (exch_total % n if i == 0 else 0)
+            r.cost.charge(
+                iterations=int(iters), engine_s=engine_s / n,
+                exchange_bytes=share, direction_switches=int(switches),
+            )
+
     def _cache_put(self, key, value) -> None:
         """Cache insert that degrades instead of failing the request: a
         computed answer is never thrown away because the cache hiccuped
@@ -670,10 +727,21 @@ class Session:
             return
         # Unbatchable request (singleton list): cached fixpoint runner.
         (key, run) = batch[0].payload
+        cost = batch[0].cost
         hit = self.cache.get(key)   # raced submits may have filled it
         if hit is None:
+            t0 = spans.clock()
             hit = run(batch[0].deadline)
+            if cost is not None:
+                cost.charge(
+                    iterations=int(hit.get("iters", 0)),
+                    engine_s=spans.clock() - t0,
+                    direction_switches=int(
+                        hit.get("direction_switches", 0)),
+                )
             self._cache_put(key, hit)
+        elif cost is not None:
+            cost.outcome = "hit"     # a raced submit filled the cache
         batch[0].future.set_result(hit)
 
     def _execute_sssp_batch(self, batch: List[Request]):
@@ -713,8 +781,10 @@ class Session:
                     return [
                         ex.values_for(state, j) for j in range(len(roots))
                     ], int(iters)
+        t0 = spans.clock()
         results, iters = self._engine_execute(
             "sssp", snap, key, deadline, run_engine)
+        self._charge_batch(batch, ex, iters, spans.clock() - t0)
         for r, root, vals in zip(batch, roots, results):
             out = {"values": vals, "iters": iters, "start": root}
             self._cache_put((snap.fingerprint, "sssp", root), out)
@@ -758,8 +828,11 @@ class Session:
                     return [
                         ex.values_for(state, j) for j in range(len(roots))
                     ], int(iters), {}
+        t0 = spans.clock()
         results, iters, dirs = self._engine_execute(
             app, snap, key, deadline, run_engine)
+        self._charge_batch(batch, ex, iters, spans.clock() - t0,
+                           switches=dirs.get("direction_switches", 0))
         for r, root, vals in zip(batch, roots, results):
             out = {"values": vals, "iters": iters, "start": root}
             out.update(dirs)
@@ -1315,7 +1388,25 @@ class Session:
             return hard_sync(v)
 
         _, rep = prof.profile_window(drive, steps=steps, op_maps=op_maps)
+        # A capture is a (config -> realized overlap) observation too:
+        # the compact headline numbers go into the ledger (the full
+        # profile.v1 artifact stays under LUX_PROF_DIR).
+        ledger.record_run(
+            "profile",
+            {"steps": steps,
+             "realized_hidden_frac": rep.get("realized_hidden_frac"),
+             "devices": len(rep.get("devices") or {}),
+             "nv": int(self.graph.nv), "ne": int(self.graph.ne)},
+            graph_fingerprint=self.fingerprint, program="PageRank",
+            engine_kind="profilez", mesh_shape=self._mesh_label(),
+        )
         return rep
+
+    def costz(self) -> dict:
+        """Per-tenant cost accounting (the ``/costz`` payload)."""
+        out = self.costs.snapshot()
+        out["config"] = {"hash": flags.config_hash()}
+        return out
 
     def mesh_exchange_bytes(self) -> dict:
         """Per-app dense-estimate exchange bytes per iteration for the
@@ -1368,6 +1459,11 @@ class Session:
         probes = c["hits"] + c["misses"]
         return {
             "windows": self.slo.snapshot(),
+            # The behavioral flag config this process serves under —
+            # two /statusz payloads with different hashes are not
+            # comparable evidence (ledger A/B pairing keys on it too).
+            "config": {"hash": flags.config_hash()},
+            "costs": self.costs.totals(),
             "snapshot": {"version": self.version,
                          "fingerprint": self.fingerprint,
                          "pending_edits": self.store.pending_edits()},
@@ -1408,6 +1504,7 @@ class Session:
             "sentinel": self.pool.sentinel.stats(),
             "breaker": self.breaker.stats(),
             "degraded": self._degraded,
+            "costs": self.costs.totals(),
         }
 
     def close(self):

@@ -24,7 +24,8 @@ from typing import Dict, Optional
 
 __all__ = [
     "Flag", "define", "declared", "names", "default", "get", "get_int",
-    "get_float", "get_bool", "tristate", "table",
+    "get_float", "get_bool", "tristate", "table", "snapshot",
+    "config_hash",
 ]
 
 
@@ -125,6 +126,38 @@ def tristate(name: str, strict: bool = True) -> Optional[bool]:
     return None
 
 
+def snapshot() -> Dict[str, Optional[str]]:
+    """Effective value of every declared flag, in sorted-name order.
+
+    Secrets-free by construction: only declared ``LUX_*`` flags are
+    captured (never the whole environment), and declaring a flag is a
+    code-reviewed act. This is the config side of a ledger record
+    (obs/ledger.py) — a (config -> metrics) observation is only
+    reproducible if the config is complete.
+    """
+    return {name: get(name) for name in names()}
+
+
+def config_hash() -> str:
+    """Stable 12-hex digest of the behavioral flag config.
+
+    Path-kind flags are excluded: they name artifact sinks (metrics
+    files, cache dirs, the ledger dir itself) that differ per run/tmpdir
+    without changing behavior, and including them would make identical
+    configs hash differently — breaking ledger A/B pairing and
+    bench-gate baseline comparability, the two consumers of this hash.
+    """
+    import hashlib
+
+    items = [
+        (name, get(name))
+        for name in names()
+        if _REGISTRY[name].kind != "path"
+    ]
+    blob = "\x00".join(f"{k}={'' if v is None else v}" for k, v in items)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
 def table() -> str:
     """Human-readable flag table (name, kind, default, doc)."""
     rows = [("flag", "kind", "default", "doc")]
@@ -170,6 +203,15 @@ define("LUX_PROF_DIR", None,
        "(bench --profile, POST /profilez, SIGUSR2 toggle) write "
        "TensorBoard artifacts + profile.v1 reports under this directory",
        kind="path")
+define("LUX_LEDGER_DIR", None,
+       "arm the run ledger (obs/ledger.py): every engine run, bench "
+       "entry, serve warmup, and /profilez capture appends one "
+       "crc-framed runrec.v1 JSON line under this directory",
+       kind="path")
+define("LUX_LEDGER_ROTATE_BYTES", 8 << 20,
+       "run-ledger segment rotation threshold in bytes: a segment at or "
+       "past this size is sealed and a new runrec-NNNNNN.jsonl opens",
+       kind="int")
 define("LUX_HBM_PEAK_GBPS", None,
        "override the roofline HBM peak (GB/s) when the device-profile "
        "registry (obs/report.py) has no row for this device_kind")

@@ -1,0 +1,20 @@
+"""LUX010 fixtures: run metrics leaving the process as ad-hoc JSON.
+
+A run summary written with a bare json.dump is invisible to lux_doctor
+and the auto-tuner corpus: no crc framing, no rotation, no
+(graph, program, engine, mesh, config_hash) key to reproduce it under.
+Every run-metrics write goes through lux_tpu.obs.ledger.record_run."""
+import json
+
+
+def dump_summary(summary, path):
+    with open(path, "w") as f:
+        json.dump(summary, f)  # expect: LUX010
+
+
+def dump_telemetry_line(telemetry):
+    return json.dumps(telemetry)  # expect: LUX010
+
+
+def dump_nested(run_record, f):
+    json.dump(run_record["metrics"], f)  # expect: LUX010

@@ -1,0 +1,23 @@
+"""LUX010 clean fixture: run metrics routed through the ledger API;
+non-metric artifacts (plans, reports, payloads) keep json freely."""
+import json
+
+from lux_tpu.obs import ledger
+
+
+def record(summary_dict):
+    # The discipline: one durable runrec.v1 observation per run.
+    return ledger.record_run(
+        "engine_run", summary_dict, program="PageRank",
+        engine_kind="pull",
+    )
+
+
+def write_plan_meta(meta, path):
+    # Artifact writes that are not run metrics stay plain JSON.
+    with open(path, "w") as f:
+        json.dump(meta, f)
+
+
+def wire_payload(payload):
+    return json.dumps(payload)

@@ -36,6 +36,7 @@ BAD_FIXTURES = (
     "serve/bad_swallow.py",
     "obs/bad_metric_names.py",
     "obs/bad_region_names.py",
+    "obs/bad_ledger_dump.py",
 )
 GOOD_FIXTURES = (
     "engine/good_host_sync.py",
@@ -46,6 +47,7 @@ GOOD_FIXTURES = (
     "serve/good_swallow.py",
     "obs/good_metric_names.py",
     "obs/good_region_names.py",
+    "obs/good_ledger_dump.py",
 )
 
 

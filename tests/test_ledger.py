@@ -1,0 +1,345 @@
+"""Run ledger + per-query cost attribution.
+
+Covers the durability contract (crc framing, rotation, WAL torn-tail
+reopen, interior-corruption strictness, concurrent writers), the
+``flags.config_hash`` reproducibility rules (path-kind flags excluded),
+the ``lux doctor`` A/B attributor on a seeded regression, and the serve
+cost pipeline: per-tenant totals that agree exactly with the
+``lux_query_cost_*`` metrics, cache-hit outcomes, and the unarmed
+zero-cost default.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from lux_tpu.graph import generate
+from lux_tpu.obs import ledger, metrics
+from lux_tpu.serve import ServeConfig, Session
+from lux_tpu.serve.cost import DEFAULT_TENANT, CostAccounts, QueryCost
+from lux_tpu.utils import flags
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+DOCTOR = os.path.join(REPO, "tools", "lux_doctor.py")
+
+
+@pytest.fixture
+def armed(tmp_path, monkeypatch):
+    """Arm the ledger at a fresh directory; disarm afterwards."""
+    root = str(tmp_path / "ledger")
+    monkeypatch.setenv("LUX_LEDGER_DIR", root)
+    ledger.reset()
+    yield root
+    ledger.reset()
+
+
+def _metric_value(name, **labels):
+    for m in metrics.snapshot():
+        if m["name"] == name and m["labels"] == labels:
+            return m["value"]
+    return None
+
+
+# -- framing + durability -------------------------------------------------
+
+
+def test_record_run_roundtrip_and_frame(armed):
+    rid = ledger.record_run(
+        "engine_run", {"gteps": 1.5, "nv": 100, "ne": 700},
+        program="PageRank", engine_kind="pull",
+    )
+    assert rid
+    segs = ledger.RunLedger(armed).segments()
+    assert len(segs) == 1
+    raw = open(segs[0], "rb").read()
+    assert raw.startswith(b"LUXRR1 ") and raw.endswith(b"\n")
+    (rec,) = ledger.read_all(armed, strict=True)
+    assert rec["schema"] == ledger.SCHEMA
+    assert rec["id"] == rid
+    assert rec["kind"] == "engine_run"
+    assert rec["metrics"]["gteps"] == 1.5
+    key = rec["key"]
+    assert key["graph_fingerprint"] == "nv100-ne700"   # weak fallback
+    assert key["program"] == "PageRank"
+    assert key["config_hash"] == flags.config_hash()
+    assert rec["key_string"] == ledger.key_string(**key)
+    assert rec["config"].get("LUX_LEDGER_ROTATE_BYTES") is not None
+
+
+def test_unarmed_record_run_is_none(tmp_path, monkeypatch):
+    monkeypatch.delenv("LUX_LEDGER_DIR", raising=False)
+    ledger.reset()
+    assert not ledger.enabled()
+    assert ledger.record_run("engine_run", {"gteps": 1.0}) is None
+    assert ledger.read_all() == []
+
+
+def test_torn_tail_is_truncated_on_reopen(armed):
+    led = ledger.RunLedger(armed)
+    ledger.record_run("engine_run", {"gteps": 1.0}, program="A")
+    seg = led.segments()[0]
+    with open(seg, "ab") as f:
+        f.write(b"LUXRR1 0000dead {\"half\": ")       # crash mid-append
+    ledger.record_run("engine_run", {"gteps": 2.0}, program="B")
+    recs = ledger.read_all(armed, strict=True)        # strict: no bad lines
+    assert [r["key"]["program"] for r in recs] == ["A", "B"]
+    v = ledger.validate_dir(armed)
+    assert v["ok"] == 2 and v["interior_bad"] == 0 and v["torn_segments"] == 0
+
+
+def test_crc_bad_final_line_is_torn_not_corrupt(armed):
+    led = ledger.RunLedger(armed)
+    ledger.record_run("engine_run", {"gteps": 1.0}, program="A")
+    with open(led.segments()[0], "ab") as f:
+        f.write(b"LUXRR1 00000000 {\"bad\": \"crc\"}\n")
+    ledger.record_run("engine_run", {"gteps": 2.0}, program="B")
+    recs = ledger.read_all(armed, strict=True)
+    assert [r["key"]["program"] for r in recs] == ["A", "B"]
+
+
+def test_interior_corruption_raises_strict_skips_lenient(armed):
+    led = ledger.RunLedger(armed)
+    led.append({"schema": ledger.SCHEMA, "n": 1})
+    led.append({"schema": ledger.SCHEMA, "n": 2})
+    seg = led.segments()[0]
+    buf = bytearray(open(seg, "rb").read())
+    first_nl = buf.index(b"\n")
+    buf[first_nl - 2] ^= 0xFF                # flip a byte mid-record
+    open(seg, "wb").write(bytes(buf))
+    with pytest.raises(ledger.LedgerCorruptError):
+        ledger.read_all(armed, strict=True)
+    lenient = ledger.read_all(armed)
+    assert [r["n"] for r in lenient] == [2]
+    v = ledger.validate_dir(armed)
+    assert v["interior_bad"] == 1
+    # Reopen-for-append must NOT truncate interior corruption away: the
+    # valid line after it proves those bytes were once durable.
+    led.append({"schema": ledger.SCHEMA, "n": 3})
+    assert [r["n"] for r in ledger.read_all(armed)] == [2, 3]
+    assert ledger.validate_dir(armed)["interior_bad"] == 1
+
+
+def test_rotation_and_latest_index(armed, monkeypatch):
+    monkeypatch.setenv("LUX_LEDGER_ROTATE_BYTES", "1")   # rotate every append
+    for i in range(4):
+        ledger.record_run("engine_run", {"i": i, "nv": 8, "ne": 8},
+                          program="PageRank", engine_kind="pull")
+    led = ledger.RunLedger(armed)
+    assert len(led.segments()) == 4
+    recs = led.read(strict=True)
+    assert [r["metrics"]["i"] for r in recs] == [0, 1, 2, 3]
+    key = recs[-1]["key_string"]
+    assert led.latest(key)["metrics"]["i"] == 3
+
+
+def test_concurrent_writers_all_land(armed):
+    led = ledger.RunLedger(armed)
+
+    def spin(w):
+        for i in range(25):
+            led.append({"schema": ledger.SCHEMA, "w": w, "i": i})
+
+    threads = [threading.Thread(target=spin, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = led.read(strict=True)
+    assert len(recs) == 200
+    assert len({r["id"] for r in recs}) == 200
+
+
+# -- config_hash ----------------------------------------------------------
+
+
+def test_config_hash_ignores_path_flags(monkeypatch):
+    base = flags.config_hash()
+    monkeypatch.setenv("LUX_LEDGER_DIR", "/some/other/place")
+    assert flags.config_hash() == base      # path kind: artifact sink
+    monkeypatch.setenv("LUX_METRICS", "/tmp/m.json")
+    assert flags.config_hash() == base
+
+
+def test_config_hash_tracks_behavior_flags(monkeypatch):
+    base = flags.config_hash()
+    monkeypatch.setenv("LUX_LEDGER_ROTATE_BYTES", "12345")
+    changed = flags.config_hash()
+    assert changed != base
+    monkeypatch.setenv("LUX_LEDGER_ROTATE_BYTES", "12345")
+    assert flags.config_hash() == changed   # deterministic
+    assert flags.snapshot()["LUX_LEDGER_ROTATE_BYTES"] == "12345"
+
+
+# -- lux doctor -----------------------------------------------------------
+
+
+def test_doctor_attributes_phase_and_flag(armed, monkeypatch):
+    def seed(gteps, exchange_s, n=2):
+        for _ in range(n):
+            ledger.record_run(
+                "engine_run",
+                {"gteps": gteps, "execute_s": 1.0 / gteps,
+                 "phases": {"exchange_s": exchange_s, "compute_s": 0.30}},
+                graph_fingerprint="fp-doctor", program="PageRank",
+                engine_kind="pull", mesh_shape="1x8",
+            )
+
+    monkeypatch.setenv("LUX_LEDGER_ROTATE_BYTES", "8388608")
+    seed(gteps=2.0, exchange_s=0.10)                 # cohort A
+    monkeypatch.setenv("LUX_LEDGER_ROTATE_BYTES", "4194304")
+    seed(gteps=1.0, exchange_s=0.50)                 # cohort B: regressed
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, DOCTOR, "--dir", armed, "--json"],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 3, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["schema"] == "doctor.v1" and report["ok"] is False
+    (pair,) = report["pairs"]
+    assert pair["key"]["graph_fingerprint"] == "fp-doctor"
+    regressed = {r["metric"] for r in pair["regressions"]}
+    assert "gteps" in regressed and "phases.exchange_s" in regressed
+    assert pair["phase"] == "exchange"
+    diff = pair["config_diff"]
+    assert diff == {"LUX_LEDGER_ROTATE_BYTES":
+                    {"a": "8388608", "b": "4194304"}}
+
+
+def test_doctor_clean_on_single_cohort(armed):
+    ledger.record_run("engine_run", {"gteps": 1.0}, program="PageRank")
+    proc = subprocess.run(
+        [sys.executable, DOCTOR, "--dir", armed, "--json"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True and report["pairs"] == []
+
+
+# -- query cost accounting ------------------------------------------------
+
+
+def test_query_cost_accumulates_and_renders():
+    c = QueryCost(None, "sssp")
+    assert c.tenant == DEFAULT_TENANT and c.outcome == "miss"
+    c.charge(iterations=5, engine_s=0.25, exchange_bytes=1024,
+             direction_switches=1)
+    c.charge(iterations=2, engine_s=0.05)
+    d = c.as_dict()
+    assert d["iterations"] == 7 and d["exchange_bytes"] == 1024
+    assert d["engine_s"] == pytest.approx(0.30)
+    hdr = QueryCost("acme", "pagerank")
+    hdr.outcome = "hit"
+    assert hdr.header() == ("tenant=acme;outcome=hit;iters=0;"
+                            "engine_s=0.000000;exchange_bytes=0;switches=0")
+
+
+def test_cost_accounts_totals_match_metrics_exactly():
+    """The parity invariant: /costz totals and the lux_query_cost_*
+    metric values are incremented in the same observe() call, so for a
+    tenant only this accountant touches they are EQUAL, not close."""
+    clock = [100.0]
+    acc = CostAccounts(windows=(60.0,), now=lambda: clock[0])
+    tenant = "parity-tenant"
+    spent = []
+    for i, outcome in enumerate(["miss", "miss", "hit"]):
+        c = QueryCost(tenant, "sssp")
+        c.outcome = outcome
+        if outcome == "miss":
+            c.charge(iterations=3 + i, engine_s=0.01 * (i + 1),
+                     exchange_bytes=512 * (i + 1))
+        acc.observe(c)
+        spent.append(c)
+        clock[0] += 1.0
+    tot = acc.totals()[tenant]
+    assert tot["requests"] == 3 and tot["hits"] == 1 and tot["misses"] == 2
+    assert tot["iterations"] == sum(c.iterations for c in spent)
+    assert tot["engine_s"] == sum(c.engine_s for c in spent)
+    assert tot["exchange_bytes"] == sum(c.exchange_bytes for c in spent)
+    assert _metric_value("lux_query_cost_engine_seconds",
+                         tenant=tenant) == tot["engine_s"]
+    assert _metric_value("lux_query_cost_exchange_bytes",
+                         tenant=tenant) == tot["exchange_bytes"]
+    assert _metric_value("lux_query_cost_iterations_total",
+                         tenant=tenant) == tot["iterations"]
+    assert _metric_value("lux_query_cost_requests_total",
+                         tenant=tenant, outcome="miss") == 2
+    assert _metric_value("lux_query_cost_requests_total",
+                         tenant=tenant, outcome="hit") == 1
+    snap = acc.snapshot()
+    assert snap["schema"] == "costz.v1"
+    w = snap["windows"]["60s"][tenant]
+    assert w["count"] == 3 and w["engine_s_p50"] >= 0.0
+
+
+# -- serve end to end: costs + ledger feed-ins ----------------------------
+
+
+@pytest.fixture(scope="module")
+def costed_session(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("led") / "ledger")
+    os.environ["LUX_LEDGER_DIR"] = root
+    ledger.reset()
+    g = generate.gnp(200, 1200, seed=311)
+    cfg = ServeConfig(max_batch=2, window_s=0.05, max_queue=32,
+                      pagerank_iters=3)
+    try:
+        with Session(g, cfg) as s:
+            yield g, s, root
+    finally:
+        os.environ.pop("LUX_LEDGER_DIR", None)
+        ledger.reset()
+
+
+def test_serve_costs_per_tenant_and_ledger_records(costed_session):
+    _g, s, root = costed_session
+    tenant = "acme-test"
+    futs = [s.submit("sssp", start=r, tenant=tenant) for r in (1, 7, 42)]
+    for f in futs:
+        f.result(60)
+    costs = [f._lux_cost for f in futs]
+    assert all(c.tenant == tenant and c.outcome == "miss" for c in costs)
+    assert all(c.iterations > 0 and c.engine_s > 0.0 for c in costs)
+    # Per-query shares sum exactly to the tenant totals (batch members
+    # split the batch's engine seconds / exchange bytes with no loss).
+    tot = s.costs.totals()[tenant]
+    assert tot["requests"] == 3 and tot["misses"] == 3
+    assert tot["iterations"] == sum(c.iterations for c in costs)
+    assert tot["engine_s"] == pytest.approx(
+        sum(c.engine_s for c in costs))
+    assert tot["exchange_bytes"] == sum(c.exchange_bytes for c in costs)
+    # Metric parity for this tenant (only this session books it).
+    assert _metric_value("lux_query_cost_engine_seconds",
+                         tenant=tenant) == pytest.approx(tot["engine_s"])
+    # Cache hit books as outcome=hit with zero engine spend.
+    s.query("pagerank", tenant=tenant, timeout=60)
+    hit = s.submit("pagerank", tenant=tenant)
+    hit.result(60)
+    assert hit._lux_cost.outcome == "hit"
+    assert hit._lux_cost.engine_s == 0.0
+    assert s.costs.totals()[tenant]["hits"] >= 1
+    # Unlabeled traffic books to the default tenant.
+    s.query("sssp", start=3, timeout=60)
+    assert DEFAULT_TENANT in s.costs.totals()
+    # /costz payload carries the reproducibility hash.
+    cz = s.costz()
+    assert cz["schema"] == "costz.v1"
+    assert cz["config"]["hash"] == flags.config_hash()
+    assert cz["totals"][tenant]["requests"] >= 5
+    assert s.statusz()["config"]["hash"] == flags.config_hash()
+    # The armed ledger collected the feed-ins: warmup + engine runs.
+    recs = ledger.read_all(root, strict=True)
+    kinds = {r["kind"] for r in recs}
+    assert "serve_warmup" in kinds and "engine_run" in kinds
+    warm = next(r for r in recs if r["kind"] == "serve_warmup")
+    assert warm["key"]["program"] == "serve"
+    assert warm["metrics"]["warm_s"] > 0.0
+    assert warm["key"]["config_hash"] == flags.config_hash()
+    assert ledger.validate_dir(root)["interior_bad"] == 0

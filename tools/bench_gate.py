@@ -228,6 +228,11 @@ def run_bench(fast: bool):
         # The chip the numbers came from (jax device_kind); rounds from
         # different chips never ratchet against each other.
         "device_kind": mk.group(1).strip() if mk else "unknown",
+        # Reproducibility stamp, NOT a gate key (comparable() never
+        # reads it): the flag-registry hash that keys this round's run
+        # ledger records, so a gate artifact can be joined back to its
+        # runrec.v1 evidence.
+        "config_hash": flags.config_hash(),
     }
     return headline, context, " ".join(cmd)
 

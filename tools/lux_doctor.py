@@ -1,0 +1,318 @@
+#!/usr/bin/env python3
+"""lux doctor: attribute regressions from the run ledger.
+
+The ledger (lux_tpu/obs/ledger.py) stores every run as a
+(config -> metrics) observation keyed by (graph_fingerprint, program,
+engine_kind, mesh_shape, config_hash). The doctor closes the loop:
+group records that measured the SAME workload (everything in the key
+except config_hash), split each group into config cohorts, compare the
+two most recent cohorts (or the ``--a``/``--b`` hashes), and report
+
+- which metric moved past ``--tol`` (direction-aware: gteps down is a
+  regression, execute_s up is),
+- which phase is responsible — exchange vs compute vs build — by the
+  largest absolute time mover among exchange_s/compute_s/compile_s,
+- which flags differ between the cohorts' stored config snapshots
+  (path-kind flags excluded: artifact sinks, not behavior).
+
+``--bench A.json B.json`` additionally diffs two bench round artifacts
+(BENCH_r0N.json lineage: headline + suite gteps) through the same
+tolerance. Output is a human report on stdout; ``--json`` emits one
+``doctor.v1`` JSON line instead. Exit 0 when clean, 3 when any
+regression is attributed (the bench_gate convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lux_tpu.obs import ledger  # noqa: E402
+from lux_tpu.utils import flags  # noqa: E402
+
+# (metric path, higher_is_better). Paths reach into the nested summary.
+METRICS = (
+    ("gteps", True),
+    ("execute_s", False),
+    ("compile_s", False),
+    ("phases.exchange_s", False),
+    ("phases.compute_s", False),
+    ("useful_ratio", True),
+    ("phases.exchange_hidden_frac", True),
+    ("realized_hidden_frac", True),
+    ("warm_s", False),
+)
+
+# Phase attribution: the largest absolute mover among these names the
+# responsible phase in the report.
+PHASE_SOURCES = (
+    ("exchange", "phases.exchange_s"),
+    ("compute", "phases.compute_s"),
+    ("build", "compile_s"),
+)
+
+
+def _get(record_metrics: dict, path: str):
+    cur = record_metrics
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _mean(xs):
+    xs = [x for x in xs if x is not None]
+    return sum(xs) / len(xs) if xs else None
+
+
+def group_key(rec: dict) -> tuple:
+    k = rec.get("key", {})
+    return (k.get("graph_fingerprint"), k.get("program"),
+            k.get("engine_kind"), k.get("mesh_shape"))
+
+
+def cohorts(records, a_hash=None, b_hash=None):
+    """Split one group's records into (A, B) config cohorts.
+
+    Default pairing: B is the most recent config_hash seen, A the most
+    recent DIFFERENT one before it — "what changed since the last
+    config" — preserving record order as the arrow of time (ids are
+    appended in order; ``at`` stamps break ties across segments)."""
+    records = sorted(records, key=lambda r: r.get("at", 0.0))
+    by_hash, order = {}, []
+    for r in records:
+        h = r.get("key", {}).get("config_hash")
+        if h not in by_hash:
+            by_hash[h] = []
+        by_hash[h].append(r)
+        if h in order:
+            order.remove(h)
+        order.append(h)           # most-recently-seen last
+    if a_hash and b_hash:
+        if a_hash not in by_hash or b_hash not in by_hash:
+            return None
+        return by_hash[a_hash], by_hash[b_hash]
+    if len(order) < 2:
+        return None
+    return by_hash[order[-2]], by_hash[order[-1]]
+
+
+def aggregate(records) -> dict:
+    out = {}
+    for path, _hib in METRICS:
+        v = _mean([_get(r.get("metrics", {}), path) for r in records])
+        if v is not None:
+            out[path] = v
+    return out
+
+
+def config_diff(a_recs, b_recs) -> dict:
+    """Flags that differ between the cohorts' stored snapshots,
+    path-kind flags excluded (they name artifact sinks, and config_hash
+    itself ignores them — a differing tmpdir is not a behavior diff)."""
+    a_cfg = (a_recs[-1].get("config") or {}) if a_recs else {}
+    b_cfg = (b_recs[-1].get("config") or {}) if b_recs else {}
+    out = {}
+    for name in sorted(set(a_cfg) | set(b_cfg)):
+        if flags.declared(name) and flags._REGISTRY[name].kind == "path":
+            continue
+        av, bv = a_cfg.get(name), b_cfg.get(name)
+        if av != bv:
+            out[name] = {"a": av, "b": bv}
+    return out
+
+
+def compare(a_recs, b_recs, tol: float) -> dict:
+    a_m, b_m = aggregate(a_recs), aggregate(b_recs)
+    regressions, improvements = [], []
+    for path, hib in METRICS:
+        av, bv = a_m.get(path), b_m.get(path)
+        if av is None or bv is None:
+            continue
+        base = max(abs(av), 1e-12)
+        delta_frac = (bv - av) / base
+        moved = abs(delta_frac) > tol
+        if not moved:
+            continue
+        worse = (delta_frac < 0) if hib else (delta_frac > 0)
+        entry = {"metric": path, "a": av, "b": bv,
+                 "delta_frac": round(delta_frac, 4)}
+        (regressions if worse else improvements).append(entry)
+    # Phase attribution: among the time phases, who moved the most
+    # wall-clock? That phase owns the regression story.
+    phase, phase_delta = None, 0.0
+    for name, path in PHASE_SOURCES:
+        av, bv = a_m.get(path), b_m.get(path)
+        if av is None or bv is None:
+            continue
+        d = bv - av
+        if abs(d) > abs(phase_delta):
+            phase, phase_delta = name, d
+    for entry in regressions:
+        entry["phase"] = phase
+    return {
+        "a": {"config_hash": a_recs[-1]["key"]["config_hash"],
+              "n": len(a_recs), "metrics": a_m,
+              "record_ids": [r.get("id") for r in a_recs]},
+        "b": {"config_hash": b_recs[-1]["key"]["config_hash"],
+              "n": len(b_recs), "metrics": b_m,
+              "record_ids": [r.get("id") for r in b_recs]},
+        "regressions": regressions,
+        "improvements": improvements,
+        "phase": phase,
+        "phase_delta_s": round(phase_delta, 6) if phase else None,
+        "config_diff": config_diff(a_recs, b_recs),
+    }
+
+
+def diagnose(records, tol: float, a_hash=None, b_hash=None) -> list:
+    groups = {}
+    for r in records:
+        if r.get("schema") != ledger.SCHEMA:
+            continue
+        groups.setdefault(group_key(r), []).append(r)
+    pairs = []
+    for gkey, recs in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        pair = cohorts(recs, a_hash, b_hash)
+        if pair is None:
+            continue
+        result = compare(pair[0], pair[1], tol)
+        result["key"] = {
+            "graph_fingerprint": gkey[0], "program": gkey[1],
+            "engine_kind": gkey[2], "mesh_shape": gkey[3],
+        }
+        pairs.append(result)
+    return pairs
+
+
+def bench_diff(a_path: str, b_path: str, tol: float) -> dict:
+    """Diff two bench round artifacts (headline + suite gteps)."""
+    def load(p):
+        with open(p) as f:
+            return json.load(f)
+
+    a, b = load(a_path), load(b_path)
+    moved = []
+    rows = [("headline", a.get("value"), b.get("value"))]
+    for name in sorted(set(a.get("suite") or {}) | set(b.get("suite") or {})):
+        rows.append((
+            f"suite.{name}",
+            (a.get("suite") or {}).get(name, {}).get("gteps"),
+            (b.get("suite") or {}).get(name, {}).get("gteps"),
+        ))
+    for name, av, bv in rows:
+        if av is None or bv is None:
+            continue
+        delta_frac = (bv - av) / max(abs(av), 1e-12)
+        if delta_frac < -tol:
+            moved.append({"metric": f"{name}.gteps", "a": av, "b": bv,
+                          "delta_frac": round(delta_frac, 4)})
+    return {"a": a_path, "b": b_path, "regressions": moved}
+
+
+def render(report: dict) -> str:
+    lines = ["lux doctor: run-ledger regression attribution",
+             f"  ledger: {report['dir']}  ({report['records']} records, "
+             f"{len(report['pairs'])} comparable pair(s), "
+             f"tol={report['tol']})"]
+    if not report["pairs"]:
+        lines.append("  no comparable (A, B) config cohorts found — need "
+                     "two configs measuring the same "
+                     "(graph, program, engine, mesh).")
+    for pair in report["pairs"]:
+        k = pair["key"]
+        lines.append(
+            "  workload: program={program} engine={engine_kind} "
+            "mesh={mesh_shape} graph={graph_fingerprint}".format(
+                **{**k, "graph_fingerprint":
+                   str(k["graph_fingerprint"])[:20]}))
+        lines.append(
+            "    A config={} (n={})  ->  B config={} (n={})".format(
+                pair["a"]["config_hash"], pair["a"]["n"],
+                pair["b"]["config_hash"], pair["b"]["n"]))
+        if not pair["regressions"]:
+            lines.append("    OK: no metric moved past tolerance.")
+        for reg in pair["regressions"]:
+            lines.append(
+                "    REGRESSION {metric}: {a:.6g} -> {b:.6g} "
+                "({delta_frac:+.1%})".format(**reg))
+            if reg.get("phase"):
+                lines.append(
+                    "      responsible phase: {} ({:+.6f}s)".format(
+                        reg["phase"], pair["phase_delta_s"] or 0.0))
+        for name, d in pair["config_diff"].items():
+            lines.append(
+                "      config diff: {}: {!r} -> {!r}".format(
+                    name, d["a"], d["b"]))
+        if pair["regressions"] and not pair["config_diff"]:
+            lines.append("      config diff: none (same flags — suspect "
+                         "the code or the environment, not a knob)")
+    bench = report.get("bench")
+    if bench:
+        lines.append(f"  bench lineage: {bench['a']} -> {bench['b']}")
+        if not bench["regressions"]:
+            lines.append("    OK: no bench metric regressed.")
+        for reg in bench["regressions"]:
+            lines.append(
+                "    REGRESSION {metric}: {a:.6g} -> {b:.6g} "
+                "({delta_frac:+.1%})".format(**reg))
+    lines.append("  verdict: " + ("CLEAN" if report["ok"]
+                                  else "REGRESSED"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lux_doctor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--dir", default=None,
+                   help="ledger directory (default: LUX_LEDGER_DIR)")
+    p.add_argument("--a", default=None, dest="a_hash",
+                   help="baseline config_hash (default: second-newest)")
+    p.add_argument("--b", default=None, dest="b_hash",
+                   help="candidate config_hash (default: newest)")
+    p.add_argument("--tol", type=float, default=0.2,
+                   help="relative move past which a metric counts")
+    p.add_argument("--bench", nargs=2, metavar=("A.json", "B.json"),
+                   help="also diff two bench round artifacts")
+    p.add_argument("--json", action="store_true",
+                   help="emit one doctor.v1 JSON line instead of text")
+    args = p.parse_args(argv)
+
+    root = args.dir or flags.get("LUX_LEDGER_DIR")
+    if not root:
+        p.error("no ledger: pass --dir or set LUX_LEDGER_DIR")
+    try:
+        records = ledger.read_all(root)
+    except ledger.LedgerCorruptError as e:
+        print(f"lux doctor: corrupt ledger: {e}", file=sys.stderr)
+        return 2
+    pairs = diagnose(records, args.tol, args.a_hash, args.b_hash)
+    report = {
+        "schema": "doctor.v1",
+        "dir": root,
+        "records": len(records),
+        "tol": args.tol,
+        "pairs": pairs,
+        "validate": ledger.validate_dir(root),
+    }
+    if args.bench:
+        report["bench"] = bench_diff(args.bench[0], args.bench[1],
+                                     args.tol)
+    regressed = any(p_["regressions"] for p_ in pairs) or bool(
+        report.get("bench", {}).get("regressions"))
+    report["ok"] = not regressed
+    if args.json:
+        print(json.dumps(report, separators=(",", ":")))
+    else:
+        print(render(report))
+    return 3 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

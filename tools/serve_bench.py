@@ -56,14 +56,22 @@ class HttpClient:
     def __init__(self, url):
         self.url = url.rstrip("/")
 
-    def query(self, payload):
+    def query(self, payload, tenant=None):
         import urllib.request
 
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-Lux-Tenant"] = tenant
         req = urllib.request.Request(
-            self.url + "/query", json.dumps(payload).encode(),
-            {"Content-Type": "application/json"},
+            self.url + "/query", json.dumps(payload).encode(), headers,
         )
         with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def costz(self):
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/costz", timeout=10) as r:
             return json.loads(r.read())
 
     def batch_histogram(self):
@@ -89,11 +97,14 @@ class LocalClient:
     def __init__(self, session):
         self.session = session
 
-    def query(self, payload):
+    def query(self, payload, tenant=None):
         payload = dict(payload)
         app = payload.pop("app")
         payload.pop("full", None)
-        return self.session.query(app, **payload)
+        return self.session.query(app, tenant=tenant, **payload)
+
+    def costz(self):
+        return self.session.costz()
 
     def batch_histogram(self):
         from lux_tpu.obs import metrics
@@ -107,7 +118,8 @@ class LocalClient:
         return self.session.stats()
 
 
-def worker(client, mix, nv, stop_at, qps, lat, errs, seed):
+def worker(client, mix, nv, stop_at, qps, lat, errs, seed,
+           tenant=None, tlat=None):
     rng = random.Random(seed)
     interval = 1.0 / qps if qps else 0.0
     while time.monotonic() < stop_at:
@@ -118,8 +130,11 @@ def worker(client, mix, nv, stop_at, qps, lat, errs, seed):
             payload["start"] = rng.randrange(nv)
         t0 = time.perf_counter()
         try:
-            client.query(payload)
-            lat.setdefault(app, []).append(time.perf_counter() - t0)
+            client.query(payload, tenant=tenant)
+            dt = time.perf_counter() - t0
+            lat.setdefault(app, []).append(dt)
+            if tenant is not None and tlat is not None:
+                tlat.setdefault(tenant, []).append(dt)
         except Exception as e:
             errs[type(e).__name__] = errs.get(type(e).__name__, 0) + 1
         if interval:
@@ -145,6 +160,11 @@ def main() -> int:
                    help="serving mesh spec for the in-process session "
                    "('8' or 'PxQ'); on CPU the mesh is virtual (XLA "
                    "host devices). Default: LUX_SERVE_MESH")
+    p.add_argument("--tenants", default=None,
+                   help="comma-separated tenant labels round-robined "
+                   "over workers (X-Lux-Tenant per request); the report "
+                   "gains per-tenant latency quantiles + /costz cost "
+                   "aggregates")
     p.add_argument("--sssp-weight", type=float, default=0.8,
                    dest="sssp_weight",
                    help="fraction of traffic that is SSSP root queries "
@@ -228,13 +248,17 @@ def main() -> int:
     w = max(0.0, min(1.0, args.sssp_weight))
     mix = [("sssp", w), ("pagerank", (1 - w) / 2),
            ("components", (1 - w) / 2)]
+    tenants = [t.strip() for t in (args.tenants or "").split(",")
+               if t.strip()]
     lat: dict = {}
+    tlat: dict = {}
     errs: dict = {}
     stop_at = time.monotonic() + args.duration
     threads = [
         threading.Thread(
             target=worker,
-            args=(client, mix, nv, stop_at, args.qps, lat, errs, i),
+            args=(client, mix, nv, stop_at, args.qps, lat, errs, i,
+                  tenants[i % len(tenants)] if tenants else None, tlat),
             daemon=True,
         )
         for i in range(args.workers)
@@ -319,6 +343,31 @@ def main() -> int:
               f"[{', '.join(parts)}]")
         report["batch_size"] = {"count": hist["count"], "mean": mean,
                                 "buckets": hist["buckets"]}
+    if tenants:
+        # Per-tenant latency quantiles from the client side, joined with
+        # the server's /costz consumption totals: "tenant X waited this
+        # long and spent that much engine time" in one block.
+        try:
+            costz = client.costz()
+        except Exception:
+            costz = {}
+        report["tenants"] = {}
+        for tenant in sorted(tlat):
+            xs = sorted(tlat[tenant])
+            entry = {"n": len(xs),
+                     "p50_s": percentile(xs, 0.50),
+                     "p99_s": percentile(xs, 0.99)}
+            cost = (costz.get("totals") or {}).get(tenant)
+            if cost:
+                entry["cost"] = cost
+            report["tenants"][tenant] = entry
+            cost_str = (
+                "engine_s={engine_s:.3f} iters={iterations} "
+                "hit/miss={hits}/{misses}".format(**cost) if cost
+                else "cost n/a")
+            print(f"  tenant {tenant:<11} n={len(xs):<6} "
+                  f"p50={entry['p50_s'] * 1e3:8.2f} ms   "
+                  f"p99={entry['p99_s'] * 1e3:8.2f} ms   {cost_str}")
     # Server-side counters the SLO gate cares about: shed/reject volume
     # and the sentinel's recompile count (must be 0 post-warmup).
     try:

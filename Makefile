@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -22,8 +22,9 @@ lint-threads:
 	python tools/luxlint.py --threads
 
 # Exchange tier: ExchangePlan structure/coverage/profitability proofs
-# plus the overlap, sentinel-annihilator, and byte-accounting dataflow
-# rules over every full+compact sharded registry target (LUX401-406).
+# plus the overlap, sentinel-annihilator, byte-accounting, and
+# frontier-coverage dataflow rules over every full+compact+frontier
+# sharded registry target (LUX401-407).
 lint-exchange:
 	python tools/luxlint.py --exchange
 
@@ -33,7 +34,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -75,6 +76,15 @@ serve-sharded-smoke:
 # single-lane BFS, zero recompiles, /statusz direction-split block.
 gas-smoke:
 	python tools/gas_smoke.py
+
+# Sharded GAS acceptance (LUX_EXCHANGE=frontier): every registry app
+# answered from a 2x4 virtual mesh bitwise against the host oracles,
+# >= 1 adaptive direction switch on the single-lane BFS, an empty
+# mesh-fallback surface (counter at zero), zero recompiles across
+# switches and frontier downgrades, and the frontier-vs-compact
+# exchange-byte budget report.
+gas-sharded-smoke:
+	python tools/gas_sharded_smoke.py
 
 # Compacted-exchange acceptance (LUX_EXCHANGE=compact): bitwise parity
 # full-vs-compact for SSSP + PageRank on a 2x4 virtual mesh, >= 5x

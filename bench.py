@@ -317,6 +317,42 @@ def bench_gas(g, program, tag: str, max_iters: int, **init_kw):
     }
 
 
+def bench_gas_sharded(g, program, tag: str, max_iters: int, **init_kw):
+    """Direction-adaptive GAS over the full device mesh (the sharded
+    form of bench_gas, LUX_EXCHANGE-sensitive — the gate context keys
+    on the mode). Skipped on a single device, where the exchange is
+    inert and the number would just alias bench_gas."""
+    import jax
+
+    from lux_tpu.engine.gas_sharded import ShardedAdaptiveExecutor
+
+    if jax.device_count() < 2:
+        raise SkipItem("needs >= 2 devices for a sharded mesh")
+    ex = ShardedAdaptiveExecutor(g, program,
+                                 num_parts=jax.device_count())
+    ex.warmup(**init_kw)
+    t0 = time.perf_counter()
+    state, iters = ex.run(max_iters=max_iters, **init_kw)
+    elapsed = time.perf_counter() - t0
+    gteps = lux_gteps(g.ne, iters, elapsed)
+    log(
+        f"{tag}: P={ex.num_parts} exchange={ex.exchange_mode}: {iters} "
+        f"iters ({ex.push_iters} push/{ex.pull_iters} pull, "
+        f"{ex.direction_switches} switches, {ex.exchange_downgrades} "
+        f"downgrades) in {elapsed:.2f}s ({gteps:.3f} GTEPS)"
+    )
+    return {
+        "gteps": round(gteps, 4),
+        "iters": iters,
+        "push_iters": ex.push_iters,
+        "direction_switches": ex.direction_switches,
+        "exchange_downgrades": ex.exchange_downgrades,
+        "exchange_mode": ex.exchange_mode,
+        "exchange_bytes_per_iter": ex.exchange_bytes_per_iter(),
+        "ms_per_iter": round(elapsed / max(iters, 1) * 1e3, 2),
+    }
+
+
 def bench_cf(g, iters: int = 5):
     from lux_tpu.engine.pull import PullExecutor, hard_sync
     from lux_tpu.models.colfilter import CollaborativeFiltering
@@ -542,6 +578,18 @@ def main():
         suite_item("sssp_delta_rmat", run_sssp_delta)
         suite_item("labelprop_rmat", run_labelprop)
         suite_item("kcore_rmat", run_kcore)
+        # Mesh GAS (PR 17): the direction-adaptive engine over every
+        # available device; runs only on a real multi-device backend
+        # (virtual-CPU mesh evidence lives in `make gas-sharded-smoke`
+        # and tools/bench_sharded.py — wall time there measures
+        # dispatch, not scaling).
+        def run_bfs_sharded():
+            from lux_tpu.models.bfs import BFS
+
+            return bench_gas_sharded(g, BFS(), "bfs_sharded", 32,
+                                     start=0)
+
+        suite_item("bfs_sharded_rmat", run_bfs_sharded)
         # Deadline-skipped items fall back to the most recent completed
         # measurement of the SAME code (git HEAD match), clearly labeled
         # — tunnel upload/compile throughput varies run to run, and a

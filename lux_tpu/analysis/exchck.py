@@ -31,6 +31,15 @@ sampling:
   ``useful_bytes_per_iter`` model (obs/engobs.useful_exchange) must
   re-derive from the counts matrix — so the advertised packed-vs-useful
   ratio can never drift from the code that computes it.
+- LUX407 frontier-coverage (plans carrying frontier evidence only —
+  the LUX_EXCHANGE=frontier activity-packed send): the frontier
+  capacity must fit inside the static compact capacity (the frontier
+  send reuses the compact plan's routing, only shorter), the
+  executor's per-pair send slots must fit that capacity, the packer
+  must never truncate active rows (``frontier_fill_active == 0`` — a
+  dense frontier downgrades to the compact send instead of dropping
+  rows), and the advertised frontier bytes must re-derive from
+  ``P * (P-1) * slots * frontier_row_bytes``.
 
 numpy + stdlib only, mirroring planck.py: plans are host arrays and a
 verifier must not drag in jax. The IR half of the tier (LUX404-406,
@@ -63,14 +72,24 @@ EXCH_FORMAT = 1
 
 def plan_view(plan, remote_read_counts=None, row_bytes: Optional[int] = None,
               declared_bytes_per_iter: Optional[int] = None,
-              ledger: Optional[dict] = None) -> types.SimpleNamespace:
+              ledger: Optional[dict] = None,
+              frontier_capacity: Optional[int] = None,
+              frontier_max_sends: Optional[int] = None,
+              frontier_row_bytes: Optional[int] = None,
+              frontier_bytes_per_iter: Optional[int] = None,
+              frontier_fill_active: Optional[int] = None
+              ) -> types.SimpleNamespace:
     """Wrap an in-memory ExchangePlan (or anything attribute-compatible)
     plus optional evidence into the namespace the LUX40x rules read.
 
     ``remote_read_counts`` is the ShardedGraph value-row matrix (LUX402
     conservation); ``row_bytes``/``declared_bytes_per_iter``/``ledger``
-    feed the LUX403 pricing checks. Evidence left as None skips only
-    the checks that need it."""
+    feed the LUX403 pricing checks; the ``frontier_*`` fields are the
+    adaptive GAS engine's frontier-exchange evidence (LUX407). Evidence
+    left as None skips only the checks that need it."""
+    def _i(x):
+        return None if x is None else int(x)
+
     return types.SimpleNamespace(
         num_parts=int(plan.num_parts),
         max_units=int(plan.max_units),
@@ -83,10 +102,14 @@ def plan_view(plan, remote_read_counts=None, row_bytes: Optional[int] = None,
                                 int(plan.capacity) < int(plan.max_units))),
         remote_read_counts=(None if remote_read_counts is None
                             else np.asarray(remote_read_counts)),
-        row_bytes=None if row_bytes is None else int(row_bytes),
-        declared_bytes_per_iter=(None if declared_bytes_per_iter is None
-                                 else int(declared_bytes_per_iter)),
+        row_bytes=_i(row_bytes),
+        declared_bytes_per_iter=_i(declared_bytes_per_iter),
         ledger=dict(ledger) if ledger is not None else None,
+        frontier_capacity=_i(frontier_capacity),
+        frontier_max_sends=_i(frontier_max_sends),
+        frontier_row_bytes=_i(frontier_row_bytes),
+        frontier_bytes_per_iter=_i(frontier_bytes_per_iter),
+        frontier_fill_active=_i(frontier_fill_active),
     )
 
 
@@ -425,8 +448,63 @@ class ExchProfitability(ExchRule):
                         f"useful/exchanged {want_ratio:.6f}")
 
 
+class FrontierCoverage(ExchRule):
+    id = "LUX407"
+    title = "frontier-coverage"
+    doc = ("frontier-exchange evidence must be admissible: frontier "
+           "capacity within [1, capacity], per-pair send slots within "
+           "that capacity, zero truncated active rows (dense frontiers "
+           "downgrade, never drop), and the advertised frontier bytes "
+           "re-derived from P * (P-1) * slots * frontier_row_bytes")
+
+    def check(self, view, path: str) -> Iterable[Finding]:
+        fcap = getattr(view, "frontier_capacity", None)
+        if fcap is None:
+            return   # no frontier evidence attached; nothing to verify
+        if not _shape_ok(view):
+            return   # LUX401 territory
+        P = view.num_parts
+        if not 1 <= fcap <= view.capacity:
+            yield self.finding(
+                path, 0,
+                f"frontier_capacity {fcap} outside [1, {view.capacity}] "
+                "— the frontier send must reuse (a prefix of) the "
+                "compact plan's per-pair slots, never exceed them")
+            return
+        sends = getattr(view, "frontier_max_sends", None)
+        if sends is not None and not 0 <= sends <= fcap:
+            yield self.finding(
+                path, 0,
+                f"frontier_max_sends {sends} exceeds frontier_capacity "
+                f"{fcap} — the packer can emit more rows than the "
+                "admissibility check budgets, so active rows truncate")
+        fill = getattr(view, "frontier_fill_active", None)
+        if fill:
+            yield self.finding(
+                path, 0,
+                f"frontier_fill_active = {int(fill)}: the packer "
+                "truncated active rows instead of downgrading to the "
+                "static compact send — results can silently drop "
+                "frontier vertices")
+        frb = getattr(view, "frontier_row_bytes", None)
+        fbytes = getattr(view, "frontier_bytes_per_iter", None)
+        if frb is not None and frb < 1:
+            yield self.finding(
+                path, 0, f"frontier_row_bytes {frb} must be >= 1")
+        elif fbytes is not None and frb is not None:
+            slots = fcap if sends is None else sends
+            want = P * (P - 1) * slots * frb
+            if int(fbytes) != want:
+                yield self.finding(
+                    path, 0,
+                    f"frontier_bytes_per_iter {fbytes} != re-derived "
+                    f"{want} (P*(P-1) pairs x {slots} slots x {frb} B) "
+                    "— the frontier byte model drifted from the packer")
+
+
 def all_exchange_rules() -> List[ExchRule]:
-    return [ExchStructure(), ExchCoverage(), ExchProfitability()]
+    return [ExchStructure(), ExchCoverage(), ExchProfitability(),
+            FrontierCoverage()]
 
 
 def verify_exchange_plan(view, path: str = "<exchange-plan>",
@@ -503,8 +581,10 @@ def audit_exchange(engine, name: str) -> List[Finding]:
                 declared = int(bytes_fn())
             except Exception:
                 declared = None
+        fe = getattr(engine, "frontier_evidence", None)
+        frontier = (fe() or {}) if callable(fe) else {}
         view = plan_view(plan, remote_read_counts=counts,
-                         declared_bytes_per_iter=declared)
+                         declared_bytes_per_iter=declared, **frontier)
         res = verify_exchange_plan(view, path=name)
     # luxlint: disable=LUX007 -- advisory audit: a malformed plan must surface as a finding, never take down an engine build
     except Exception as e:

@@ -84,7 +84,7 @@ class TraceTarget:
     # Exchange-tier metadata (LUX404-406); plan-carrying sharded
     # executors expose these in their trace dicts, everything else
     # leaves the defaults and the LUX40x IR rules skip the target.
-    exchange_mode: str = ""         # "full" / "compact" ("" = not sharded)
+    exchange_mode: str = ""   # "full" / "compact" / "frontier" ("" = flat)
     exchange_bytes: Optional[int] = None  # exchange_bytes_per_iter claim
     combiner: str = ""              # program combiner ("min"/"max"/"sum")
     value_dtype: str = ""           # dtype of the exchanged value rows
@@ -694,7 +694,9 @@ class OverlapProof(IRRule):
            "local-edge work is data-independent of the exchange")
 
     def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
-        if target.exchange_mode != "compact":
+        # Frontier targets keep the compact plan's packed all_to_all as
+        # the dense-iteration branch, so the same merge proof applies.
+        if target.exchange_mode not in ("compact", "frontier"):
             return
         ordinals = _eqn_ordinals(closed.jaxpr)
         tainted, axis, inputs = _global_dataflow(closed)
@@ -765,7 +767,7 @@ class SentinelAnnihilator(IRRule):
            "sum) so sentinel traffic can never reach a result")
 
     def check(self, closed, target: TraceTarget) -> Iterable[Finding]:
-        if target.exchange_mode != "compact" or \
+        if target.exchange_mode not in ("compact", "frontier") or \
                 target.combiner not in ("min", "max", "sum"):
             return
         comb = target.combiner
@@ -991,6 +993,12 @@ def build_executor(kind: str, graph, program):
     if kind == "gas_multi":
         from lux_tpu.engine.gas import MultiSourceGasExecutor
         return MultiSourceGasExecutor(graph, program, k=4)
+    if kind == "gas_sharded":
+        from lux_tpu.engine.gas_sharded import ShardedAdaptiveExecutor
+        return ShardedAdaptiveExecutor(graph, program)
+    if kind == "gas_multi_sharded":
+        from lux_tpu.engine.gas_sharded import ShardedMultiSourceGasExecutor
+        return ShardedMultiSourceGasExecutor(graph, program, k=4)
     raise ValueError(f"unknown executor kind {kind!r}")
 
 
@@ -1063,6 +1071,31 @@ def _registry_executors(include_sharded: bool = True,
                     name, kind)
                 continue
             yield f"{name}@{kind}+compact", kind, exc, init_kw
+            if kind != "gas_sharded":
+                continue
+            # The adaptive GAS engine additionally carries the
+            # frontier-compacted send (LUX_EXCHANGE=frontier): trace it
+            # too so LUX404-407 cover the activity-packed all_to_all.
+            os.environ["LUX_EXCHANGE"] = "frontier"
+            try:
+                exf = build_executor(
+                    kind, _compact_graph(kind, weighted, 7 + i), program)
+            finally:
+                if prev is None:
+                    os.environ.pop("LUX_EXCHANGE", None)
+                else:
+                    os.environ["LUX_EXCHANGE"] = prev
+            if getattr(exf, "exchange_mode", "full") != "frontier":
+                # Frontier-less programs downgrade to compact by design
+                # (no activity plane to pack); only a frontier program
+                # landing elsewhere is lost coverage.
+                if getattr(exf.program, "frontier", False):
+                    get_logger("luxlint").warning(
+                        "%s@%s+frontier fell back to %s; frontier "
+                        "collectives untraced for this target",
+                        name, kind, exf.exchange_mode)
+                continue
+            yield f"{name}@{kind}+frontier", kind, exf, init_kw
 
 
 def registry_targets(include_sharded: bool = True) -> List[TraceTarget]:
@@ -1087,6 +1120,8 @@ def _exchange_row_bytes(kind: str, ex) -> Optional[int]:
         return 5 * int(ex.k)
     if kind == "tiled_sharded":
         return 4
+    if kind in ("gas_sharded", "gas_multi_sharded"):
+        return int(ex._row_bytes())
     return None
 
 
@@ -1120,8 +1155,14 @@ def _plan_evidence(kind: str, ex, plan) -> dict:
                 "useful_bytes_per_iter": useful * row_bytes,
                 "ratio": useful / max(exchanged, 1),
             }
-    return {"remote_read_counts": counts, "row_bytes": row_bytes,
-            "ledger": ledger}
+    out = {"remote_read_counts": counts, "row_bytes": row_bytes,
+           "ledger": ledger}
+    # Frontier-exchange evidence (LUX407), present only on the adaptive
+    # GAS executor built under LUX_EXCHANGE=frontier.
+    fe = getattr(ex, "frontier_evidence", None)
+    if callable(fe):
+        out.update(fe() or {})
+    return out
 
 
 def run_exchange_matrix(select=None) -> LintReport:

@@ -157,6 +157,31 @@ class ExchangePlan:
         path (with a log note) when this is False."""
         return self.capacity < self.max_units
 
+    def frontier_capacity(self, frac: float = 0.25, multiple: int = 8) -> int:
+        """Static per-(sender, receiver) row budget for the
+        frontier-aware exchange (``LUX_EXCHANGE=frontier``).
+
+        The frontier exchange sends only the subset of a pair's static
+        ``send_units`` whose source vertex is active this iteration,
+        compacted into this many slots (sentinel-padded, so shapes —
+        and therefore compiled executables — never depend on runtime
+        frontier density). It is derived from the static ``capacity``
+        rather than from any runtime measurement: ``frac`` of the
+        densest pair's padded budget, rounded up to ``multiple`` and
+        clamped to ``capacity`` (a frontier can never need more rows
+        than the static plan already covers). Iterations whose
+        per-pair active-row count exceeds this budget self-downgrade to
+        the static compact send — the plan never truncates (the LUX407
+        admissibility contract)."""
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(
+                f"frontier capacity fraction must be in (0, 1] (got {frac})"
+            )
+        cap = _round_up(
+            max(1, int(np.ceil(self.capacity * float(frac)))), multiple
+        )
+        return min(self.capacity, cap)
+
     @staticmethod
     def from_needs(
         needs,

@@ -40,18 +40,23 @@ ROOTED_APPS = frozenset(
 # tiled is spmv-only (sum combiner, identity contrib, scalar values);
 # push needs a PushProgram; multi-source batching needs a rooted app;
 # gas runs every program (legacy models through the engine/program.py
-# ``as_gas`` adapters — PullPrograms as frontier-less dense pull);
-# gas_multi needs a rooted frontier program.
+# ``as_gas`` adapters — PullPrograms as frontier-less dense pull), and
+# gas_sharded mirrors that universality on the mesh (frontier-less
+# programs run its dense pull path); gas_multi / gas_multi_sharded need
+# a rooted frontier program.
 ENGINE_KINDS = {
-    "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded", "gas"),
+    "pagerank": ("pull", "tiled", "pull_sharded", "tiled_sharded", "gas",
+                 "gas_sharded"),
     "sssp": ("push", "push_multi", "push_incremental", "push_sharded",
-             "push_multi_sharded", "gas", "gas_multi"),
-    "components": ("push", "push_incremental", "push_sharded", "gas"),
-    "colfilter": ("pull", "pull_sharded", "gas"),
-    "bfs": ("gas", "gas_multi"),
-    "sssp_delta": ("gas", "gas_multi"),
-    "labelprop": ("gas",),
-    "kcore": ("gas",),
+             "push_multi_sharded", "gas", "gas_multi", "gas_sharded",
+             "gas_multi_sharded"),
+    "components": ("push", "push_incremental", "push_sharded", "gas",
+                   "gas_sharded"),
+    "colfilter": ("pull", "pull_sharded", "gas", "gas_sharded"),
+    "bfs": ("gas", "gas_multi", "gas_sharded", "gas_multi_sharded"),
+    "sssp_delta": ("gas", "gas_multi", "gas_sharded", "gas_multi_sharded"),
+    "labelprop": ("gas", "gas_sharded"),
+    "kcore": ("gas", "gas_sharded"),
 }
 
 

@@ -177,3 +177,39 @@ def run_push_phased(ex, state, max_iters, rec):
         if cnt == 0:
             break
     return state, total, sparse_total
+
+
+def run_gas_phased(ex, state, max_iters, rec):
+    """Phase-fenced fixpoint for the sharded direction-adaptive GAS
+    engine: per-iteration exchange/compute/merge split, the branch
+    taken (``push`` | ``pull`` | ``pull/frontier`` | ``pull/downgraded``
+    | ``pull/dense``), direction switches, and frontier-exchange
+    downgrades. Returns (state, iterations_run, push_iterations,
+    direction_switches, exchange_downgrades)."""
+    with Timer() as t:
+        ex.warmup_phases(state)
+    rec.record_compile(t.elapsed)
+    total = 0
+    push_total = 0
+    switches = 0
+    downgrades = 0
+    prev_push = None
+    limit = None if max_iters is None else int(max_iters)
+    while limit is None or total < limit:
+        state, cnt, times = ex.phase_step(state)
+        # Metadata, not a wall: pop before _split sums numeric values.
+        downgrades += int(times.pop("downgraded", 0) or 0)
+        exchange, compute = _split(times)
+        branch = times.get("branch")
+        is_push = isinstance(branch, str) and branch.startswith("push")
+        if is_push:
+            push_total += 1
+        if prev_push is not None and is_push != prev_push:
+            switches += 1
+        prev_push = is_push
+        total += 1
+        rec.record_phase(total, exchange, compute, frontier=cnt,
+                         branch=branch, detail=times)
+        if cnt == 0:
+            break
+    return state, total, push_total, switches, downgrades

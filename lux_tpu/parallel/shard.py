@@ -37,24 +37,27 @@ def exchange_mode() -> str:
     flip mid-process only affects engines built after it — the serving
     pool keys carry the mode for exactly this reason."""
     v = (flags.get("LUX_EXCHANGE") or "full").strip().lower()
-    if v not in ("full", "compact"):
+    if v not in ("full", "compact", "frontier"):
         raise ValueError(
-            f"LUX_EXCHANGE={v!r}: use 'full' (whole-shard all_gather) or "
-            "'compact' (needed-rows packed exchange)"
+            f"LUX_EXCHANGE={v!r}: use 'full' (whole-shard all_gather), "
+            "'compact' (needed-rows packed exchange), or 'frontier' "
+            "(active-rows packed exchange with static-compact downgrade)"
         )
     return v
 
 
-def resolve_exchange(sg: "ShardedGraph", log=None):
+def resolve_exchange(sg: "ShardedGraph", log=None, frontier_ok: bool = False):
     """(mode, plan) an executor should build with: the requested mode,
     downgraded to ``("full", None)`` whenever compaction cannot help —
     P=1 (compaction must be a no-op: the build emits the exact full-mode
     program), released edge arrays (no plan can be derived), or an
     unprofitable plan (densest pair needs >= max_nv rows, so packing
-    would move more than the all_gather). Downgrades are logged, never
-    silent."""
+    would move more than the all_gather). ``frontier`` additionally
+    needs an executor whose exchange carries per-iteration activity
+    (``frontier_ok``) — the frontier-less executors honestly run the
+    static compact plan instead. Downgrades are logged, never silent."""
     mode = exchange_mode()
-    if mode != "compact":
+    if mode == "full":
         return "full", None
     if sg.num_parts <= 1:
         return "full", None
@@ -68,9 +71,16 @@ def resolve_exchange(sg: "ShardedGraph", log=None):
         plan = None
     if plan is None:
         if log is not None:
-            log.info("LUX_EXCHANGE=compact falling back to full: %s", why)
+            log.info("LUX_EXCHANGE=%s falling back to full: %s", mode, why)
         return "full", None
-    return "compact", plan
+    if mode == "frontier" and not frontier_ok:
+        if log is not None:
+            log.info(
+                "LUX_EXCHANGE=frontier: this executor's exchange has no "
+                "per-iteration activity plane; using the static compact plan"
+            )
+        return "compact", plan
+    return mode, plan
 
 
 def _round_up(x: int, m: int) -> int:

@@ -144,6 +144,13 @@ class Session:
         )
         self._requests = metrics.counter("lux_serve_requests_total")
         self._latency = metrics.histogram("lux_serve_request_seconds")
+        # app -> reason for every engine that had to drop from the mesh
+        # to a per-chip build; /statusz turns a non-empty dict into a
+        # warning and the smoke test asserts the counter stays at zero.
+        # Leaf lock: writes happen inside pool builds (pool lock held),
+        # reads on the /statusz thread — never nest another lock inside.
+        self._fallback_lock = make_lock("session.mesh_fallback")
+        self._mesh_fallbacks: Dict[str, str] = {}
         self.slo = slo.SloWindows()
         self.costs = CostAccounts()
         self._served_keys = set()   # batcher-thread only
@@ -351,17 +358,45 @@ class Session:
     def _gas_key_extra(self, app: str, extra=()) -> tuple:
         return (app,) + tuple(extra) + (1,)
 
+    def _note_mesh_fallback(self, app: str, why: str) -> None:
+        """Record that ``app`` dropped from the mesh to a per-chip
+        engine: counter for dashboards, dict for the /statusz warning,
+        log line for the operator reading the console."""
+        metrics.counter(
+            "lux_serve_mesh_fallback_total", {"app": app}).inc()
+        with self._fallback_lock:
+            self._mesh_fallbacks[app] = why
+        self.log.warning(
+            "mesh fallback: %s serves per-chip on a %d-part mesh: %s",
+            app, self.meshspec.num_parts, why)
+
     def _gas_single(self, app: str, snap: Optional[Snapshot] = None,
                     extra=()):
-        # GAS engines run single-device even on a sharded session: the
-        # adaptive executor's per-iteration direction flip has no sharded
-        # counterpart yet (tracked as a ROADMAP follow-up), and a wrong
-        # single-chip answer would be worse than a slower correct one.
         from lux_tpu.engine.gas import AdaptiveExecutor
 
         snap = snap or self._serving
+        key = self._engine_key("gas", snap, self._gas_key_extra(app, extra))
+        if self.sharded:
+            from lux_tpu.engine.gas_sharded import ShardedAdaptiveExecutor
+
+            def build():
+                try:
+                    return ShardedAdaptiveExecutor(
+                        snap.graph, self._gas_program(app, extra),
+                        mesh=self.meshspec.mesh,
+                        sg=self._shard_plan(snap),
+                    )
+                except Exception as e:  # luxlint: disable=LUX007
+                    # A per-chip answer is still correct; a dead app is
+                    # not. But the drop must be loud: counted, warned on
+                    # /statusz, and visible in the log — never silent.
+                    self._note_mesh_fallback(app, repr(e))
+                    return AdaptiveExecutor(
+                        snap.graph, self._gas_program(app, extra))
+
+            return self.pool.get(key, build)
         return self.pool.get(
-            self._engine_key("gas", snap, self._gas_key_extra(app, extra)),
+            key,
             lambda: AdaptiveExecutor(
                 snap.graph, self._gas_program(app, extra)),
         )
@@ -372,8 +407,26 @@ class Session:
 
         snap = snap or self._serving
         k = self.config.max_batch
+        key = self._engine_key("gas_multi", snap, (app, k))
+        if self.sharded:
+            from lux_tpu.engine.gas_sharded import (
+                ShardedMultiSourceGasExecutor)
+
+            def build():
+                try:
+                    return ShardedMultiSourceGasExecutor(
+                        snap.graph, get_program(app), k=k,
+                        mesh=self.meshspec.mesh,
+                        sg=self._shard_plan(snap),
+                    )
+                except Exception as e:  # luxlint: disable=LUX007
+                    self._note_mesh_fallback(app + "_multi", repr(e))
+                    return MultiSourceGasExecutor(
+                        snap.graph, get_program(app), k=k)
+
+            return self.pool.get(key, build)
         return self.pool.get(
-            self._engine_key("gas_multi", snap, (app, k)),
+            key,
             lambda: MultiSourceGasExecutor(
                 snap.graph, get_program(app), k=k),
         )
@@ -814,7 +867,7 @@ class Session:
                         "direction_pull": int(ex.pull_iters),
                         "direction_switches": int(ex.direction_switches),
                     }
-                    return [np.asarray(state.values)], int(iters), dirs
+                    return [_host_values(ex, state)], int(iters), dirs
         else:
             key = self._engine_key(
                 "gas_multi", snap, (app, self.config.max_batch)
@@ -883,7 +936,7 @@ class Session:
         def run_engine():
             with spans.span("serve.engine", app=app, engine="gas"):
                 state, iters = ex.run()
-                vals = np.asarray(state.values)
+                vals = _host_values(ex, state)
                 out = {
                     "values": vals, "iters": int(iters),
                     "direction_push": int(ex.push_iters),
@@ -1321,12 +1374,21 @@ class Session:
                      and isinstance(k[-1], tuple) else None)
             label = "x".join(map(str, shape)) if shape else "?"
             by_shape[label] = by_shape.get(label, 0) + 1
+        with self._fallback_lock:
+            fallbacks = dict(self._mesh_fallbacks)
         return {
             "spec": self.meshspec.spec,
             "shape": list(self.meshspec.shape),
             "num_parts": self.meshspec.num_parts,
             "pool_entries": by_shape,
             "plans": plan_cache().stats(),
+            # Apps that could not build on the mesh and dropped to a
+            # per-chip engine (correct answers, none of the scaling).
+            # Empty is the healthy state; the serve smoke asserts it.
+            "fallbacks": fallbacks,
+            **({"warning": "mesh fallback active: "
+                           + ", ".join(sorted(fallbacks))}
+               if fallbacks else {}),
             # Latest engine-observatory telemetry per engine: phase
             # split, useful-bytes ratio, frontier density ({} until an
             # instrumented run has happened in this process).
@@ -1425,6 +1487,20 @@ class Session:
             fn = getattr(ex, "exchange_bytes_per_iter", None)
             if fn is not None:
                 out[app] = int(fn())
+        # GAS engines report only when already warm: this accessor must
+        # stay cheap (no surprise compiles from an evidence request).
+        snap = self._serving
+        warm = set(self.pool.keys())
+        for app in tuple(self._gas_rooted) + tuple(self._gas_fixpoints):
+            extra = (2,) if app == "kcore" else ()
+            key = self._engine_key(
+                "gas", snap, self._gas_key_extra(app, extra))
+            if key not in warm:
+                continue
+            ex = self._gas_single(app, extra=extra)
+            fn = getattr(ex, "exchange_bytes_per_iter", None)
+            if fn is not None:
+                out["gas_" + app] = int(fn())
         return out
 
     def stats(self) -> dict:

@@ -367,8 +367,17 @@ define("LUX_EXCHANGE", "full",
        "tables every iteration; 'compact' sends only the rows some "
        "receiving part actually reads (fixed-capacity all_to_all of "
        "packed rows + receiver scatter, bitwise-equal results, "
-       "local-first overlap). Captured at executor build; P=1 and "
+       "local-first overlap); 'frontier' (sharded GAS) sends only the "
+       "compact rows whose source vertex is active this iteration, "
+       "packed to a static frontier capacity, self-downgrading to the "
+       "static compact send on dense iterations — frontier-less "
+       "executors run 'compact'. Captured at executor build; P=1 and "
        "unprofitable plans fall back to full")
+define("LUX_EXCHANGE_FRONTIER_FRAC", 0.25,
+       "frontier-exchange row budget as a fraction of the static "
+       "compact capacity (ExchangePlan.frontier_capacity): smaller = "
+       "bigger byte win on sparse iterations but earlier self-downgrade "
+       "to the static compact send", kind="float")
 
 # Multi-chip serving (serve/mesh.py, serve/session.py)
 define("LUX_SERVE_MESH", 1,

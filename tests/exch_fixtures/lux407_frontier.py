@@ -1,0 +1,73 @@
+"""Seeded LUX407 violations: frontier-exchange evidence that lies.
+
+The base plan (and its first, clean PLANS entry) satisfies LUX401-403
+and carries honest frontier evidence — frontier capacity inside the
+compact capacity, zero truncated active rows, bytes re-derivable from
+``P * (P-1) * slots * frontier_row_bytes``. Each seeded entry breaks
+exactly one frontier claim, so only the frontier-coverage rule can
+catch it:
+
+- ``lux407-truncated-active``: the packer claims it dropped active
+  rows instead of downgrading to the static compact send.
+- ``lux407-capacity-overflow``: frontier capacity exceeds the compact
+  plan's per-pair capacity, so the send cannot reuse its routing.
+- ``lux407-sends-overflow``: per-pair send slots exceed the
+  admissibility budget the downgrade check enforces.
+- ``lux407-bytes-drift``: the advertised frontier bytes diverge from
+  the packer's own pricing.
+
+Loaded by ``tools/luxlint.py --exchange <this file>``; must exit 1
+with exactly LUX407.
+"""
+
+import types
+
+import numpy as np
+
+
+def _base_plan():
+    counts = np.array([[0, 2], [1, 0]], dtype=np.int64)
+    send = np.array([[4, 4, 2, 4],
+                     [1, 3, 4, 4]], dtype=np.int32)
+    recv = np.array([[8, 8, 5, 7],
+                     [2, 8, 8, 8]], dtype=np.int32)
+    return types.SimpleNamespace(
+        num_parts=2, max_units=4, unit_rows=1, capacity=2,
+        counts=counts, send_units=send, recv_pos=recv, profitable=True)
+
+
+def _evidence(**kw):
+    out = {
+        "remote_read_counts": np.array([[0, 2], [1, 0]], dtype=np.int64),
+        "row_bytes": 8,
+        "declared_bytes_per_iter": 32,
+        # Honest frontier evidence: 1 slot per pair, value + int32 row
+        # id = 12 B per row, 2 * (2-1) * 1 * 12 = 24 B per iteration.
+        "frontier_capacity": 1,
+        "frontier_max_sends": 1,
+        "frontier_row_bytes": 12,
+        "frontier_bytes_per_iter": 24,
+        "frontier_fill_active": 0,
+    }
+    out.update(kw)
+    return out
+
+
+PLANS = [
+    # Clean: honest frontier evidence passes every LUX40x rule.
+    {"name": "lux407-clean", "plan": _base_plan(), **_evidence()},
+    # expect: LUX407 (active rows truncated instead of downgraded)
+    {"name": "lux407-truncated-active", "plan": _base_plan(),
+     **_evidence(frontier_fill_active=3)},
+    # expect: LUX407 (frontier capacity cannot exceed the compact
+    # plan's per-pair capacity it reuses)
+    {"name": "lux407-capacity-overflow", "plan": _base_plan(),
+     **_evidence(frontier_capacity=5, frontier_bytes_per_iter=120,
+                 frontier_max_sends=5)},
+    # expect: LUX407 (send slots exceed the admissibility budget)
+    {"name": "lux407-sends-overflow", "plan": _base_plan(),
+     **_evidence(frontier_max_sends=2, frontier_bytes_per_iter=48)},
+    # expect: LUX407 (advertised bytes drift from P*(P-1)*slots*row)
+    {"name": "lux407-bytes-drift", "plan": _base_plan(),
+     **_evidence(frontier_bytes_per_iter=999)},
+]

@@ -229,7 +229,10 @@ def test_exchange_matrix_clean_and_fast():
     # Both halves are present: dataflow targets and their plan twins.
     assert any(n.endswith("+compact") for n in names)
     assert any(n.endswith("/plan") for n in names)
-    assert report.elapsed_s <= 2.0, f"tier budget blown: {report.elapsed_s}"
+    # Round 17 grew the matrix by the gas_sharded targets plus a third
+    # (frontier) exchange mode for every frontier program; the PERF.md
+    # tier budget moved 2 s -> 4 s with it (~2.5 s measured).
+    assert report.elapsed_s <= 4.0, f"tier budget blown: {report.elapsed_s}"
 
 
 # -- the overlap proof catches the flipped body --------------------------
@@ -290,6 +293,7 @@ def test_flipped_compact_pull_trips_overlap_proof(monkeypatch):
     ("LUX404", "lux404_overlap"),
     ("LUX405", "lux405_sentinel"),
     ("LUX406", "lux406_bytes"),
+    ("LUX407", "lux407_frontier"),
 ])
 def test_cli_fixture_fails_with_exactly_its_rule(rule, stem):
     proc = _run_cli("--exchange", os.path.join(EXCH_FIXTURES, stem + ".py"))

@@ -1,0 +1,309 @@
+"""ShardedAdaptiveExecutor: direction-adaptive GAS on the mesh — the
+bitwise parity matrix against the single-device AdaptiveExecutor, the
+zero-recompile contract across direction switches and exchange modes,
+the frontier-exchange edge cases (empty frontier, dense self-downgrade,
+tiny-capacity overflow, P=1 inertness), the engobs phase split, and the
+serving layer's counted mesh-fallback path."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lux_tpu.analysis.sentinel import RecompileSentinel
+from lux_tpu.engine.gas import AdaptiveExecutor, GasState, as_gas
+from lux_tpu.engine.gas_sharded import (
+    ShardedAdaptiveExecutor,
+    ShardedMultiSourceGasExecutor,
+)
+from lux_tpu.graph import generate
+from lux_tpu.models import ENGINE_KINDS, PROGRAMS, get_program
+from lux_tpu.models.bfs import reference_bfs
+from lux_tpu.obs import engobs, metrics, report
+
+# Per-program init kwargs and (for the frontier-less pull programs) the
+# iteration budget run() requires.
+INIT = {
+    "pagerank": {}, "sssp": {"start": 1}, "components": {},
+    "colfilter": {}, "bfs": {"start": 1}, "sssp_delta": {"start": 0},
+    "labelprop": {}, "kcore": {},
+}
+MAXIT = {"pagerank": 6, "colfilter": 4}
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return generate.rmat(8, 8, seed=5, weighted=True)
+
+
+@pytest.fixture(scope="module")
+def refs(graph):
+    """Single-device AdaptiveExecutor oracle, computed once per app."""
+    cache = {}
+
+    def get(name):
+        if name not in cache:
+            prog = as_gas(get_program(name))
+            ex = AdaptiveExecutor(
+                graph, prog, mode="adaptive" if prog.frontier else None)
+            st, iters = ex.run(max_iters=MAXIT.get(name), **INIT[name])
+            cache[name] = (np.asarray(jax.device_get(st.values)), iters)
+        return cache[name]
+
+    return get
+
+
+def _build(graph, name, xmode, monkeypatch, num_parts=8, **kw):
+    monkeypatch.setenv("LUX_EXCHANGE", xmode)
+    prog = as_gas(get_program(name))
+    return ShardedAdaptiveExecutor(
+        graph, get_program(name), num_parts=num_parts,
+        mode="adaptive" if prog.frontier else None, **kw)
+
+
+# -- bitwise parity matrix: every program x every exchange mode ----------
+
+
+@pytest.mark.parametrize("name", sorted(PROGRAMS))
+def test_parity_all_modes(graph, refs, name, monkeypatch):
+    ref_vals, ref_iters = refs(name)
+    frontier = as_gas(get_program(name)).frontier
+    for xmode in ("full", "compact", "frontier"):
+        ex = _build(graph, name, xmode, monkeypatch)
+        st, iters = ex.run(max_iters=MAXIT.get(name), **INIT[name])
+        np.testing.assert_array_equal(
+            ex.gather_values(st), ref_vals,
+            err_msg=f"{name} P=8 LUX_EXCHANGE={xmode}")
+        assert iters == ref_iters
+        if xmode == "frontier" and not frontier:
+            # Honest downgrade: no activity plane to refine with.
+            assert ex.exchange_mode != "frontier"
+
+
+def test_pinned_directions_parity(graph, monkeypatch):
+    """Pinned push and pinned pull agree bitwise with the same pin on
+    one device, under both packed exchanges."""
+    for name in ("bfs", "sssp"):
+        prog = as_gas(get_program(name))
+        for pin in ("push", "pull"):
+            ref_st, _ = AdaptiveExecutor(graph, prog, mode=pin).run(
+                **INIT[name])
+            ref_vals = np.asarray(jax.device_get(ref_st.values))
+            for xmode in ("compact", "frontier"):
+                monkeypatch.setenv("LUX_EXCHANGE", xmode)
+                ex = ShardedAdaptiveExecutor(
+                    graph, get_program(name), num_parts=2, mode=pin)
+                st, _ = ex.run(**INIT[name])
+                np.testing.assert_array_equal(
+                    ex.gather_values(st), ref_vals,
+                    err_msg=f"pin {name}/{pin} LUX_EXCHANGE={xmode}")
+
+
+def test_multi_source_lanes_parity(graph, monkeypatch):
+    roots = [2, 9, 17]
+    monkeypatch.setenv("LUX_EXCHANGE", "frontier")
+    mx = ShardedMultiSourceGasExecutor(
+        graph, get_program("bfs"), k=4, num_parts=8)
+    # The K-lane exchange has no single-lane activity plane: honest
+    # downgrade to the static compact plan, never a dynamic send.
+    assert mx.exchange_mode == "compact"
+    state, _ = mx.run(roots)
+    assert mx.exchange_downgrades == 0
+    for j, r in enumerate(roots):
+        ref_st, _ = AdaptiveExecutor(
+            graph, as_gas(get_program("bfs")), mode="adaptive").run(start=r)
+        np.testing.assert_array_equal(
+            mx.values_for(state, j),
+            np.asarray(jax.device_get(ref_st.values)),
+            err_msg=f"lane {j} root {r}")
+
+
+def test_engine_kind_registry():
+    """Every program runs sharded; every rooted program batches sharded
+    (the LUX104/LUX105 trace matrix builds from this registry)."""
+    for name, cls in PROGRAMS.items():
+        kinds = ENGINE_KINDS[name]
+        assert "gas_sharded" in kinds, name
+        assert ("gas_multi_sharded" in kinds) == bool(
+            getattr(cls, "rooted", False)), name
+
+
+# -- zero recompiles across direction switches and both sends ------------
+
+
+def test_adaptive_switches_without_recompile(graph, monkeypatch):
+    monkeypatch.setenv("LUX_EXCHANGE", "frontier")
+    sent = RecompileSentinel("gas-sharded")
+    if not sent.available:
+        sent.close()
+        pytest.skip("jax monitoring hook unavailable in this jax")
+    try:
+        with sent.expect("bfs"):
+            ex = ShardedAdaptiveExecutor(
+                graph, get_program("bfs"), num_parts=8, mode="adaptive")
+            ex.warmup(start=1)
+        with sent.watch("bfs"):
+            st, iters = ex.run(start=1)
+            st2, _ = ex.run(start=7)
+        sent.assert_zero_recompiles()
+    finally:
+        sent.close()
+    # The run actually exercised both directions and a switch — the
+    # hysteresis crossed hi/lo at least once on this graph.
+    assert ex.push_iters > 0 and ex.pull_iters > 0
+    assert ex.direction_switches >= 1
+    ref_st, _ = AdaptiveExecutor(
+        graph, as_gas(get_program("bfs")), mode="adaptive").run(start=7)
+    np.testing.assert_array_equal(
+        ex.gather_values(st2), np.asarray(jax.device_get(ref_st.values)))
+
+
+# -- frontier-exchange edge cases ----------------------------------------
+
+
+@pytest.mark.parametrize("xmode", ["compact", "frontier"])
+def test_empty_frontier_iteration_is_identity(graph, monkeypatch, xmode):
+    """A step with no active vertices exchanges only identities: values
+    come back bitwise unchanged and the new frontier is empty."""
+    ex = _build(graph, "bfs", xmode, monkeypatch, num_parts=4)
+    state = ex.init_state(start=1)
+    empty = GasState(
+        state.values, state.frontier & False, state.direction)
+    before = ex.gather_values(empty)
+    new_state, cnt = ex.step(empty)      # donates `empty`
+    assert int(np.asarray(jax.device_get(cnt)).sum()) == 0
+    np.testing.assert_array_equal(ex.gather_values(new_state), before)
+    assert not np.asarray(jax.device_get(new_state.frontier)).any()
+
+
+def test_dense_frontier_self_downgrades(graph, refs, monkeypatch):
+    """labelprop starts all-active: the admissibility guard must route
+    dense iterations onto the static compact send (counted, never
+    truncated) while results stay bitwise equal."""
+    ex = _build(graph, "labelprop", "frontier", monkeypatch)
+    assert ex.exchange_mode == "frontier"
+    st, _ = ex.run()
+    assert ex.exchange_downgrades >= 1
+    np.testing.assert_array_equal(ex.gather_values(st), refs("labelprop")[0])
+
+
+def test_tiny_capacity_overflow_downgrades_not_truncates(
+        graph, refs, monkeypatch):
+    """With the frontier budget squeezed to ~one row per pair, almost
+    every iteration overflows: all of them must downgrade and the final
+    values must still match the oracle exactly."""
+    monkeypatch.setenv("LUX_EXCHANGE_FRONTIER_FRAC", "0.001")
+    ex = _build(graph, "bfs", "frontier", monkeypatch)
+    assert ex.exchange_mode == "frontier" and ex.frontier_cap >= 1
+    st, iters = ex.run(start=1)
+    assert ex.exchange_downgrades >= 1
+    assert iters == refs("bfs")[1]
+    np.testing.assert_array_equal(ex.gather_values(st), refs("bfs")[0])
+
+
+def test_p1_exchange_is_inert(graph, refs, monkeypatch):
+    """One part: every exchange mode resolves to the no-op full path
+    and the advertised cross-device traffic is zero."""
+    ex = _build(graph, "bfs", "frontier", monkeypatch, num_parts=1)
+    assert ex.exchange_mode == "full" and ex._xplan is None
+    assert ex.exchange_bytes_per_iter() == 0
+    assert ex.frontier_evidence() is None
+    st, iters = ex.run(start=1)
+    assert iters == refs("bfs")[1]
+    np.testing.assert_array_equal(ex.gather_values(st), refs("bfs")[0])
+
+
+def test_bfs_parent_plane_under_frontier(graph, monkeypatch):
+    """finalize() derives the parent plane from exact depths: the
+    sentinel-padded dynamic exchange must not perturb the min-id
+    tie-break on the index-valued plane."""
+    ex = _build(graph, "bfs", "frontier", monkeypatch)
+    st, _ = ex.run(start=1)
+    depth_ref, parent_ref = reference_bfs(graph, start=1)
+    np.testing.assert_array_equal(ex.gather_values(st), depth_ref)
+    np.testing.assert_array_equal(ex.finalize(st)["parent"], parent_ref)
+
+
+def test_frontier_evidence_satisfies_lux407(graph, monkeypatch):
+    """The live executor's LUX407 evidence passes its own lint rule
+    against the live plan (the fixture file covers the violations)."""
+    from lux_tpu.analysis import exchck
+
+    ex = _build(graph, "bfs", "frontier", monkeypatch)
+    fe = ex.frontier_evidence()
+    assert fe is not None and 1 <= fe["frontier_capacity"]
+    assert fe["frontier_capacity"] <= ex._xplan.capacity
+    view = exchck.plan_view(
+        ex._xplan, row_bytes=ex._row_bytes(),
+        declared_bytes_per_iter=ex.exchange_bytes_per_iter(),
+        remote_read_counts=ex.sg.remote_read_counts(), **fe)
+    findings = []
+    for rule in exchck.all_exchange_rules():
+        findings.extend(rule.check(view, "<live>") or [])
+    assert not findings, [f.format() for f in findings]
+
+
+# -- engobs phase split ---------------------------------------------------
+
+
+def test_engobs_phased_run_labels_branches(graph, refs, tmp_path, monkeypatch):
+    ref_vals = refs("bfs")[0]     # materialize before LUX_METRICS is set
+    mpath = str(tmp_path / "m.jsonl")
+    monkeypatch.setenv("LUX_METRICS", mpath)
+    monkeypatch.setenv("LUX_ENGOBS", "1")
+    monkeypatch.setenv("LUX_EXCHANGE", "frontier")
+    engobs.reset()
+    ex = ShardedAdaptiveExecutor(
+        graph, get_program("bfs"), num_parts=4, mode="adaptive")
+    st, iters = ex.run(start=1)
+    np.testing.assert_array_equal(ex.gather_values(st), ref_vals)
+
+    run = report.read_last(mpath)
+    assert run["engine"] == "gas_sharded" and run["num_iters"] == iters
+    ph = run["phases"]
+    assert ph["exchange_s"] > 0 and ph["compute_s"] > 0
+    labels = [r["branch"] for r in run["iterations"]]
+    assert set(labels) <= {
+        "push", "pull", "pull/frontier", "pull/downgraded"}
+    assert sum(lbl == "push" for lbl in labels) == ex.push_iters
+    assert (sum(lbl == "pull/downgraded" for lbl in labels)
+            == ex.exchange_downgrades)
+    note = engobs.latest()["gas_sharded"]
+    assert note["direction_switches"] == ex.direction_switches
+
+
+# -- serving: counted, never-silent mesh fallback -------------------------
+
+
+def test_serve_mesh_fallback_is_counted_and_surfaced(graph, monkeypatch):
+    from lux_tpu.engine import gas_sharded as engine_mod
+    from lux_tpu.serve.session import ServeConfig, Session
+
+    monkeypatch.setenv("LUX_EXCHANGE", "compact")
+    ctr = metrics.counter("lux_serve_mesh_fallback_total", {"app": "bfs"})
+    base = ctr.value
+    depth_ref, parent_ref = reference_bfs(graph, start=1)
+
+    # Healthy sharded session: bfs serves from the mesh, counter still.
+    with Session(graph, ServeConfig(mesh="2"), warm=False) as s:
+        got = s.query("bfs", start=1, timeout=300)
+        np.testing.assert_array_equal(got["values"], depth_ref)
+        np.testing.assert_array_equal(got["parent"], parent_ref)
+        assert s.stats()["mesh"]["fallbacks"] == {}
+        assert "warning" not in s.stats()["mesh"]
+        assert ctr.value == base
+
+    # Broken mesh build: the per-chip engine still answers, and the
+    # drop is counted and shouted on /statusz.
+    def boom(*a, **kw):
+        raise RuntimeError("forced mesh build failure")
+
+    # session.py imports the class at build time, so patching the
+    # engine module is what its `from ... import` resolves.
+    monkeypatch.setattr(engine_mod, "ShardedAdaptiveExecutor", boom)
+    with Session(graph, ServeConfig(mesh="2"), warm=False) as s2:
+        got2 = s2.query("bfs", start=1, timeout=300)
+        np.testing.assert_array_equal(got2["values"], depth_ref)
+        assert "bfs" in s2.stats()["mesh"]["fallbacks"]
+        assert "mesh fallback active" in s2.stats()["mesh"]["warning"]
+        assert ctr.value == base + 1

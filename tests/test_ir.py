@@ -61,10 +61,20 @@ def _matrix_names():
     """Expected registry-matrix target names: every program x capable
     executor, plus a ``+compact`` variant per sharded kind (the compact
     fixture graphs are chosen so the plan always engages — a fallback
-    would shrink collective-audit coverage and fail here)."""
+    would shrink collective-audit coverage and fail here), plus a
+    ``+frontier`` variant for every frontier program on the adaptive
+    sharded GAS engine (frontier-less programs downgrade to compact by
+    design and carry no extra target)."""
+    from lux_tpu.engine.gas import as_gas
+    from lux_tpu.models import get_program
+
     want = {f"{p}@{k}" for p, kinds in ENGINE_KINDS.items() for k in kinds}
     want |= {f"{p}@{k}+compact" for p, kinds in ENGINE_KINDS.items()
              for k in kinds if k.endswith("sharded")}
+    want |= {f"{p}@gas_sharded+frontier"
+             for p, kinds in ENGINE_KINDS.items()
+             if "gas_sharded" in kinds
+             and as_gas(get_program(p)).frontier}
     return want
 
 

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -34,7 +34,7 @@ plan-check:
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py
@@ -108,6 +108,14 @@ prof-smoke:
 # recompiles.
 ledger-smoke:
 	env JAX_PLATFORMS=cpu python tools/ledger_smoke.py
+
+# Auto-tuner acceptance: seeded synthetic where a known-better
+# non-default exchange mode must be selected, real probe records in
+# the ledger, luxlint --tune clean over the artifacts, serving warmup
+# applying the tuned config with zero recompiles and bitwise-identical
+# BFS results, lux_doctor --tuned attribution.
+tune-smoke:
+	env JAX_PLATFORMS=cpu python tools/tune_smoke.py
 
 # Concurrency acceptance: burst + mid-burst swap + forced compaction
 # with LockWatch armed — zero lock-order inversions, zero failed

@@ -49,6 +49,13 @@ window (obs/prof.py) under LUX_PROF_DIR (default
 write ``profile_v1.json`` next to the trace. A profiled run's GTEPS is
 overlap evidence, not a headline record — the capture perturbs the
 measurement (PERF.md evidence policy v4).
+
+``--tuned``: GAS suite entries additionally run under their TuneCache
+winner (lux_tpu/tune; searched and persisted under ``LUX_TUNE_DIR`` on
+first use), emitting ``<name>_tuned`` rows next to the default rows in
+the same artifact. The headline JSON carries ``tuned: true/false`` and
+the gate context records it (tools/bench_gate.py), so tuned and
+default rounds never ratchet against each other.
 """
 
 from __future__ import annotations
@@ -317,6 +324,42 @@ def bench_gas(g, program, tag: str, max_iters: int, **init_kw):
     }
 
 
+def bench_gas_tuned(g, program, app: str, max_iters: int, **init_kw):
+    """The bench_gas measurement with engines built under the TuneCache
+    winner for (g, app) — searched and persisted on first use, reused
+    from the artifact store after. Emitted NEXT TO the default row so
+    tuned-vs-default is one artifact; the gate context carries
+    ``tuned: true`` so these rounds never ratchet against default ones
+    (tools/bench_gate.py)."""
+    from lux_tpu.engine.gas import as_gas
+    from lux_tpu.obs import report
+    from lux_tpu.tune import make_key, tune, tune_cache
+    from lux_tpu.utils import flags
+    from lux_tpu.utils.checkpoint import fingerprint_hex
+
+    tc = tune_cache()
+    if not tc.enabled():
+        raise SkipItem("--tuned needs LUX_TUNE_DIR for the artifact store")
+    fp = fingerprint_hex(g)
+    key = make_key(fp, app, "gas", "1",
+                   report.device_profile()["device_kind"])
+    art = tc.get(key)
+    if art is None:
+        log(f"{app}: no tuneconf.v1 for {fp[:12]}..; searching")
+        t0 = time.time()
+        art = tune(g, as_gas(program), "gas", program_name=app,
+                   graph_fingerprint=fp, init_kw=init_kw)
+        tc.put(art)
+        log(f"{app}: searched {art['id']} in {time.time()-t0:.1f}s")
+    log(f"{app}: tuned config {art['id']} score={art['score']:.4g}s/iter "
+        f"{art['config']}")
+    with flags.overrides(art["config"]):
+        res = bench_gas(g, program, f"{app}_tuned", max_iters, **init_kw)
+    res["tune_artifact"] = art["id"]
+    res["tune_config"] = art["config"]
+    return res
+
+
 def bench_gas_sharded(g, program, tag: str, max_iters: int, **init_kw):
     """Direction-adaptive GAS over the full device mesh (the sharded
     form of bench_gas, LUX_EXCHANGE-sensitive — the gate context keys
@@ -402,6 +445,14 @@ def main():
         profile_dir = flags.get("LUX_PROF_DIR") or os.path.join(
             cache, "profile")
         log(f"profiling the headline run -> {profile_dir}")
+    # --tuned: GAS suite entries additionally run under their TuneCache
+    # winner (lux_tpu/tune), tuned rows next to the default ones in the
+    # same artifact. The headline JSON carries tuned: true/false so the
+    # gate never ratchets tuned and default rounds against each other.
+    tuned_mode = "--tuned" in sys.argv[1:]
+    if tuned_mode and not flags.get("LUX_TUNE_DIR"):
+        raise SystemExit("--tuned needs LUX_TUNE_DIR (the tuneconf.v1 "
+                         "artifact store)")
 
     from lux_tpu.utils.platform import ensure_backend
 
@@ -431,6 +482,7 @@ def main():
         "layout": layout,
         "achieved_gbps": head["achieved_gbps"],
         "hbm_peak_frac": head["hbm_peak_frac"],
+        "tuned": tuned_mode,
         # Iteration telemetry of THE headline measurement (per-iteration
         # walls + compile/execute split), so the round artifact shows
         # not just the number but where the time went.
@@ -578,6 +630,18 @@ def main():
         suite_item("sssp_delta_rmat", run_sssp_delta)
         suite_item("labelprop_rmat", run_labelprop)
         suite_item("kcore_rmat", run_kcore)
+        if tuned_mode:
+            # Tuned rows ride the same suite (and the same ledger), so
+            # one artifact answers "what did the tuner buy" per app.
+            from lux_tpu.models.bfs import BFS
+            from lux_tpu.models.labelprop import LabelPropagation
+
+            suite_item("bfs_rmat_tuned",
+                       lambda: bench_gas_tuned(g, BFS(), "bfs", 32,
+                                               start=0))
+            suite_item("labelprop_rmat_tuned",
+                       lambda: bench_gas_tuned(g, LabelPropagation(),
+                                               "labelprop", 16))
         # Mesh GAS (PR 17): the direction-adaptive engine over every
         # available device; runs only on a real multi-device backend
         # (virtual-CPU mesh evidence lives in `make gas-sharded-smoke`

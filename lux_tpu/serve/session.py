@@ -151,6 +151,12 @@ class Session:
         # reads on the /statusz thread — never nest another lock inside.
         self._fallback_lock = make_lock("session.mesh_fallback")
         self._mesh_fallbacks: Dict[str, str] = {}
+        # Profile-guided tuning (lux_tpu/tune): (fingerprint, app) ->
+        # tuneconf.v1 artifact resolved at warmup. Reads on the query
+        # path are lock-free dict.get (entries are immutable and only
+        # ever swapped whole); writes share the leaf fallback lock.
+        self._tuned: Dict[tuple, dict] = {}
+        self._tune_fallbacks: Dict[str, str] = {}
         self.slo = slo.SloWindows()
         self.costs = CostAccounts()
         self._served_keys = set()   # batcher-thread only
@@ -193,12 +199,20 @@ class Session:
         # Sharded keys also carry the exchange mode captured at build
         # (LUX_EXCHANGE): a full-exchange engine warmed before a flag
         # flip must not answer for compact (different executables, same
-        # results) — the pool warms a fresh entry instead.
+        # results) — the pool warms a fresh entry instead. When the app
+        # serves under a tuned config, the artifact's exchange mode wins
+        # over the ambient flag: warmup builds inside the tuned overlay
+        # and query threads run outside it, so only the artifact keeps
+        # the two key computations identical (a mismatch would miss the
+        # pool and recompile per query).
         key = (kind, snap.fingerprint) + tuple(extra)
         if self.sharded:
             from lux_tpu.parallel.shard import exchange_mode
 
-            key = key + (exchange_mode(),)
+            art = self._tuned_art(extra[0] if extra else None, snap)
+            mode = (art or {}).get("config", {}).get("LUX_EXCHANGE") \
+                or exchange_mode()
+            key = key + (mode,)
         return key + (self.meshspec.shape,)
 
     @property
@@ -221,14 +235,15 @@ class Session:
         if self.sharded:
             return self.pool.get(
                 self._engine_key("push", snap, ("sssp", 1)),
-                lambda: ShardedPushExecutor(
+                self._tuned_build("sssp", snap, lambda: ShardedPushExecutor(
                     snap.graph, SSSP(), mesh=self.meshspec.mesh,
                     sg=self._shard_plan(snap),
-                ),
+                )),
             )
         return self.pool.get(
             self._engine_key("push", snap, ("sssp", 1)),
-            lambda: PushExecutor(snap.graph, SSSP()),
+            self._tuned_build(
+                "sssp", snap, lambda: PushExecutor(snap.graph, SSSP())),
         )
 
     def _sssp_multi(self, snap: Optional[Snapshot] = None):
@@ -241,14 +256,16 @@ class Session:
         if self.sharded:
             return self.pool.get(
                 self._engine_key("push_multi", snap, ("sssp", k)),
-                lambda: ShardedMultiSourcePushExecutor(
-                    snap.graph, SSSP(), k=k, mesh=self.meshspec.mesh,
-                    sg=self._shard_plan(snap),
-                ),
+                self._tuned_build(
+                    "sssp", snap, lambda: ShardedMultiSourcePushExecutor(
+                        snap.graph, SSSP(), k=k, mesh=self.meshspec.mesh,
+                        sg=self._shard_plan(snap),
+                    )),
             )
         return self.pool.get(
             self._engine_key("push_multi", snap, ("sssp", k)),
-            lambda: MultiSourcePushExecutor(snap.graph, SSSP(), k=k),
+            self._tuned_build("sssp", snap, lambda: MultiSourcePushExecutor(
+                snap.graph, SSSP(), k=k)),
         )
 
     def _components_engine(self, snap: Optional[Snapshot] = None):
@@ -259,14 +276,16 @@ class Session:
         if self.sharded:
             return self.pool.get(
                 self._engine_key("push", snap, ("components", 1)),
-                lambda: ShardedPushExecutor(
-                    snap.graph, ConnectedComponents(),
-                    mesh=self.meshspec.mesh, sg=self._shard_plan(snap),
-                ),
+                self._tuned_build(
+                    "components", snap, lambda: ShardedPushExecutor(
+                        snap.graph, ConnectedComponents(),
+                        mesh=self.meshspec.mesh, sg=self._shard_plan(snap),
+                    )),
             )
         return self.pool.get(
             self._engine_key("push", snap, ("components", 1)),
-            lambda: PushExecutor(snap.graph, ConnectedComponents()),
+            self._tuned_build("components", snap, lambda: PushExecutor(
+                snap.graph, ConnectedComponents())),
         )
 
     def _pagerank_engine(self, snap: Optional[Snapshot] = None):
@@ -305,7 +324,8 @@ class Session:
             return make_executor(snap.graph, PageRank(), args, self.log)
 
         return self.pool.get(
-            self._engine_key("pull", snap, ("pagerank",)), build
+            self._engine_key("pull", snap, ("pagerank",)),
+            self._tuned_build("pagerank", snap, build),
         )
 
     # -- GAS apps (direction-optimizing adaptive executor) ----------------
@@ -370,6 +390,123 @@ class Session:
             "mesh fallback: %s serves per-chip on a %d-part mesh: %s",
             app, self.meshspec.num_parts, why)
 
+    # -- profile-guided tuning (lux_tpu/tune) -----------------------------
+
+    def _tune_engine_kind(self, app: str) -> str:
+        """The engine kind a tune artifact for ``app`` is keyed under:
+        the app's primary serving executor. Layout choice is part of
+        the key on purpose — each layout tunes separately."""
+        if app == "pagerank":
+            base = "pull"
+        elif app in ("sssp", "components"):
+            base = "push"
+        else:
+            base = "gas"
+        return base + ("_sharded" if self.sharded else "")
+
+    def _tuned_art(self, app, snap: Snapshot) -> Optional[dict]:
+        with self._fallback_lock:
+            return self._tuned.get((snap.fingerprint, app))
+
+    def _tuned_overlay(self, app: str, snap: Snapshot):
+        """Scoped flag overlay applying ``app``'s tuned config so an
+        engine *build* captures the tuned knobs (every tuner-managed
+        flag is capture-at-build — the tuned path adds zero per-query
+        compiles); a no-op when the app serves under defaults."""
+        art = self._tuned_art(app, snap)
+        if art is None:
+            return contextlib.nullcontext()
+        return flags.overrides(art["config"])
+
+    def _load_tuned(self, snap: Snapshot) -> dict:
+        """Resolve each served app's ``tuneconf.v1`` artifact for
+        ``snap`` from the TuneCache before its engines build. A miss is
+        a counted fallback to defaults (``lux_tune_fallback_total``,
+        the /statusz tune block) — never silent; an unarmed tuner
+        (LUX_TUNE_DIR unset) shows as ``armed: false`` there instead."""
+        from lux_tpu.obs import report
+        from lux_tpu.tune import key_string, make_key, tune_cache
+
+        tc = tune_cache()
+        found: Dict[str, str] = {}
+        if not tc.enabled():
+            return found
+        device_kind = report.device_profile()["device_kind"]
+        for app in self.APPS:
+            key = make_key(snap.fingerprint, app,
+                           self._tune_engine_kind(app),
+                           self._mesh_label(), device_kind)
+            art = tc.get(key)
+            if art is None:
+                metrics.counter(
+                    "lux_tune_fallback_total", {"app": app}).inc()
+                with self._fallback_lock:
+                    self._tune_fallbacks[app] = (
+                        f"no tuneconf.v1 for {snap.fingerprint[:12]}; "
+                        "serving defaults")
+                self.log.info(
+                    "tune fallback: %s v%d serves under default config "
+                    "(no artifact for key %r)", app, snap.version,
+                    key_string(key))
+                continue
+            with self._fallback_lock:
+                self._tuned[(snap.fingerprint, app)] = art
+                self._tune_fallbacks.pop(app, None)
+            found[app] = art["id"]
+            self.log.info(
+                "tuned config %s for %s v%d: %s (score %.3gs/iter, %d "
+                "probes)", art["id"], app, snap.version, art["config"],
+                art["score"], len(art.get("score_table") or ()))
+        return found
+
+    def tuned_for(self, app: str) -> Optional[dict]:
+        """Tune provenance for ``app`` on the serving snapshot
+        (``{id, score}`` or None) — the HTTP layer stamps the
+        ``X-Lux-Tuned`` response header from it."""
+        art = self._tuned_art(str(app), self._serving)
+        if art is None:
+            return None
+        return {"id": art["id"], "score": art["score"]}
+
+    def _tune_block(self) -> dict:
+        """The /statusz ``tune`` view: per-app artifact provenance
+        (id, score, probe count, age), counted fallbacks, cache
+        health."""
+        from lux_tpu.tune import tune_cache
+
+        snap = self._serving
+        # Artifact created_at is unix wall time (tune/artifact.py), so
+        # the age math needs the wall clock, not the span epoch.
+        now = time.time()  # luxlint: disable=LUX006 -- age vs artifact created_at needs unix wall time
+        with self._fallback_lock:
+            arts = {app: a for (fp, app), a in self._tuned.items()
+                    if fp == snap.fingerprint}
+            fallbacks = dict(self._tune_fallbacks)
+        return {
+            "armed": tune_cache().enabled(),
+            "artifacts": {
+                app: {"id": a["id"], "score": a["score"],
+                      "config": a["config"],
+                      "probes": len(a.get("score_table") or ()),
+                      "age_s": round(now - float(a.get("created_at",
+                                                       now)), 1)}
+                for app, a in sorted(arts.items())
+            },
+            "fallbacks": fallbacks,
+            "cache": tune_cache().stats(),
+        }
+
+    def _tuned_build(self, app: str, snap: Snapshot, build):
+        """Wrap an engine builder so every pool miss — warmup, a
+        breaker rebuild, the first use of a sibling key — constructs
+        under ``app``'s tuned overlay. Tuned knobs are capture-at-build,
+        so this is the single point where they take effect; the query
+        path only ever sees warm engines."""
+        def wrapped():
+            with self._tuned_overlay(app, snap):
+                return build()
+        return wrapped
+
     def _gas_single(self, app: str, snap: Optional[Snapshot] = None,
                     extra=()):
         from lux_tpu.engine.gas import AdaptiveExecutor
@@ -394,11 +531,11 @@ class Session:
                     return AdaptiveExecutor(
                         snap.graph, self._gas_program(app, extra))
 
-            return self.pool.get(key, build)
+            return self.pool.get(key, self._tuned_build(app, snap, build))
         return self.pool.get(
             key,
-            lambda: AdaptiveExecutor(
-                snap.graph, self._gas_program(app, extra)),
+            self._tuned_build(app, snap, lambda: AdaptiveExecutor(
+                snap.graph, self._gas_program(app, extra))),
         )
 
     def _gas_multi(self, app: str, snap: Optional[Snapshot] = None):
@@ -424,11 +561,11 @@ class Session:
                     return MultiSourceGasExecutor(
                         snap.graph, get_program(app), k=k)
 
-            return self.pool.get(key, build)
+            return self.pool.get(key, self._tuned_build(app, snap, build))
         return self.pool.get(
             key,
-            lambda: MultiSourceGasExecutor(
-                snap.graph, get_program(app), k=k),
+            self._tuned_build(app, snap, lambda: MultiSourceGasExecutor(
+                snap.graph, get_program(app), k=k)),
         )
 
     def warmup(self, snap: Optional[Snapshot] = None):
@@ -439,6 +576,11 @@ class Session:
         it stays flat across the query phase."""
         snap = snap or self._serving
         t_warm0 = spans.clock()
+        # Resolve tuned configs BEFORE any engine builds: each app's
+        # engines construct inside its tuned overlay, so the tuner's
+        # knobs (all capture-at-build) are baked into the warm
+        # executables and the query path compiles nothing new.
+        tuned = self._load_tuned(snap)
         with spans.span("serve.warmup", version=snap.version):
             faults.point("snapshot.warm")
             with _timed(self.log, "warmup sssp single"):
@@ -471,6 +613,7 @@ class Session:
              "pool": self.pool.stats()},
             graph_fingerprint=snap.fingerprint, program="serve",
             engine_kind="warmup", mesh_shape=self._mesh_label(),
+            tuned=tuned,
         )
 
     def _mesh_label(self) -> str:
@@ -1250,8 +1393,19 @@ class Session:
             # engines — a sharded swap atomically replaces the whole
             # mesh of engines plus the host-side plan they shared.
             plans = plan_cache().evict_fingerprint(old_fp)
+            # Tuned configs are fingerprint-keyed like shard plans:
+            # version N's artifacts must not influence N+1's engine keys
+            # or overlays (the disk artifacts stay — they are evidence).
+            from lux_tpu.tune import tune_cache
+
+            tunes = tune_cache().evict_fingerprint(old_fp)
+            with self._fallback_lock:
+                stale = [k for k in self._tuned if k[0] == old_fp]
+                for k in stale:
+                    del self._tuned[k]
             return {"evicted": evicted, "retired": retired,
-                    "plans_evicted": plans}
+                    "plans_evicted": plans,
+                    "tunes_evicted": tunes + len(stale)}
 
         while True:
             try:
@@ -1515,6 +1669,7 @@ class Session:
             "cache": self.cache.stats(),
             "batcher": self.batcher.stats(),
             "mesh": self._mesh_block(),
+            "tune": self._tune_block(),
             "requests": int(self._requests.value),
         }
         if self._latency.count:
@@ -1553,6 +1708,7 @@ class Session:
             "cache_hit_rate": (c["hits"] / probes) if probes else None,
             "batch_size": self.batcher.batch_histogram(),
             "mesh": self._mesh_block(),
+            "tune": self._tune_block(),
             # Latest adaptive-executor direction split (push/pull iters,
             # mid-run switches) per GAS engine kind; {} until one runs.
             "gas": {kind: rec for kind, rec in engobs.latest().items()

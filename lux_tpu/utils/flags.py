@@ -13,19 +13,28 @@ Accessors re-read ``os.environ`` on every call — flags stay runtime
 knobs (CLI flags and tests set env vars after first import; cf.
 logging.reconfigure / trace.reconfigure).
 
+:func:`overrides` layers a scoped, context-local overlay on top of the
+environment: inside the ``with`` block every accessor (and therefore
+:func:`snapshot` / :func:`config_hash`) sees the overlaid values without
+mutating ``os.environ`` — the auto-tuner probes candidate configs this
+way, and ledger records written under an overlay carry the candidate
+config automatically.
+
 ``python -m lux_tpu.utils.flags`` prints the flag table.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import os
-from typing import Dict, Optional
+from typing import Dict, Mapping, Optional
 
 __all__ = [
     "Flag", "define", "declared", "names", "default", "get", "get_int",
     "get_float", "get_bool", "tristate", "table", "snapshot",
-    "config_hash",
+    "config_hash", "overrides",
 ]
 
 
@@ -77,13 +86,53 @@ def default(name: str):
     return _flag(name).default
 
 
+# Context-local overlay stack. Each layer maps flag name -> str value
+# (or None, which masks any env var and forces the declared default).
+# contextvars (not a plain global) so a probe running in one serve
+# thread can't leak its candidate config into concurrent queries.
+_OVERRIDES: contextvars.ContextVar = contextvars.ContextVar(
+    "lux_flag_overrides", default=())
+
+
+def _overlaid(name: str):
+    """(hit, value) against the innermost overlay layer naming ``name``."""
+    for layer in reversed(_OVERRIDES.get()):
+        if name in layer:
+            return True, layer[name]
+    return False, None
+
+
+@contextlib.contextmanager
+def overrides(mapping: Mapping[str, object]):
+    """Scoped flag overlay: inside the block, every accessor resolves
+    the given flags to the mapped values (stringified; ``None`` masks
+    the env var, restoring the declared default). Layers nest — inner
+    wins. Undeclared names raise up front, same contract as the
+    accessors, so a typo'd knob can't silently probe the default."""
+    frozen = {}
+    for name, value in mapping.items():
+        _flag(name)
+        frozen[name] = None if value is None else str(value)
+    token = _OVERRIDES.set(_OVERRIDES.get() + (frozen,))
+    try:
+        yield
+    finally:
+        _OVERRIDES.reset(token)
+
+
 def get(name: str) -> Optional[str]:
-    """Raw string value: the env var if set, else the declared default
+    """Raw string value: the innermost :func:`overrides` layer if one
+    names this flag, else the env var if set, else the declared default
     (coerced to str unless None)."""
     f = _flag(name)
-    v = os.environ.get(name)
-    if v is not None:
-        return v
+    hit, ov = _overlaid(name)
+    if hit:
+        if ov is not None:
+            return ov
+    else:
+        v = os.environ.get(name)
+        if v is not None:
+            return v
     return f.default if f.default is None else str(f.default)
 
 
@@ -99,7 +148,8 @@ def get_bool(name: str) -> bool:
     """Unset → declared default; '' / '0' / 'false' / 'no' / 'off'
     (case-insensitive) → False; anything else → True."""
     f = _flag(name)
-    v = os.environ.get(name)
+    hit, ov = _overlaid(name)
+    v = ov if hit else os.environ.get(name)
     if v is None:
         return bool(f.default)
     return v.strip().lower() not in ("", "0", "false", "no", "off")
@@ -111,7 +161,8 @@ def tristate(name: str, strict: bool = True) -> Optional[bool]:
     ``strict`` (the flag gates a planning decision that must not be
     silently misread), else behave as unset."""
     _flag(name)
-    v = os.environ.get(name, "")
+    hit, ov = _overlaid(name)
+    v = (ov or "") if hit else os.environ.get(name, "")
     if v == "":
         return None
     if v == "0":
@@ -389,6 +440,42 @@ define("LUX_SHARD_PLAN_CACHE", 8,
        "max (fingerprint, parts) partition plans the serving shard-plan "
        "cache keeps; hot-swaps evict the outgoing fingerprint's plans "
        "regardless", kind="int")
+
+# Profile-guided auto-tuner (lux_tpu/tune/)
+define("LUX_TUNE_DIR", None,
+       "arm the auto-tuner cache (lux_tpu/tune/): tuneconf.v1 artifacts "
+       "are persisted under this directory and serving warmup consults "
+       "them; unset = tuner disarmed, every lookup is a counted fallback "
+       "to defaults", kind="path")
+define("LUX_TUNE_PROBE_ITERS", 6,
+       "fixed iteration count of a rung-0 tuner probe; later "
+       "successive-halving rungs double it", kind="int")
+define("LUX_TUNE_RUNGS", 2,
+       "successive-halving rung count for the tuner search (1 = a single "
+       "flat sweep, no halving)", kind="int")
+define("LUX_TUNE_ETA", 2,
+       "successive-halving keep fraction: the top ceil(n/eta) candidates "
+       "by score survive each rung", kind="int")
+define("LUX_TUNE_SEED", 0,
+       "seed for the tuner's candidate subsample + deterministic "
+       "tie-breaks (same seed + graph -> identical winner and score "
+       "table)", kind="int")
+define("LUX_TUNE_MAX_CANDIDATES", 16,
+       "cap on rung-0 candidates; larger declared knob spaces are "
+       "seeded-subsampled down to this before probing", kind="int")
+define("LUX_TUNE_MAX_AGE_S", 604800.0,
+       "luxlint --tune staleness bound: a tuneconf.v1 artifact older "
+       "than this many seconds is flagged LUX504 (0 disables the bound)",
+       kind="float")
+define("LUX_TUNE_PENALTY", 0.05,
+       "tuner score penalty weight per direction switch / exchange "
+       "downgrade, as a fraction of phase time per event per iteration "
+       "(instability is a cost even when the phase medians look good)",
+       kind="float")
+define("LUX_TUNE_CACHE", 8,
+       "max tuneconf.v1 entries the in-memory TuneCache keeps "
+       "(LRU; hot-swaps evict the outgoing fingerprint's entries "
+       "regardless, like LUX_SHARD_PLAN_CACHE)", kind="int")
 
 # Smoke-tool knobs (tools/obs_smoke.py, serve_smoke.py, merge_smoke.py)
 define("LUX_SMOKE_SCALE", 10, "smoke tools R-MAT scale", kind="int")

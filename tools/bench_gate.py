@@ -42,7 +42,7 @@ from lux_tpu.utils import flags  # noqa: E402
 _LOWER_IS_BETTER = re.compile(r"(_ms_per_iter|ms_per_iter|_seconds|_s)$")
 # Context keys that must match for two rounds to be comparable.
 _CONTEXT_KEYS = ("mode", "scale", "ef", "layout", "platform", "exchange",
-                 "device_kind")
+                 "device_kind", "tuned")
 
 
 def log(msg):
@@ -135,6 +135,12 @@ def comparable(cur_ctx: dict, base_ctx: dict):
             # Baselines recorded before the exchange key existed ran
             # under the then-only full exchange.
             b = flags.default("LUX_EXCHANGE")
+        if key == "tuned":
+            # Artifacts recorded before the auto-tuner existed ran
+            # under default configs; a tuned round must never ratchet
+            # against them (nor vice versa) — same idiom as exchange.
+            c = bool(c)
+            b = bool(b)
         if key == "device_kind" and b is None:
             # A baseline that never recorded its chip could have come
             # from ANY device; numbers from different chips are
@@ -228,6 +234,11 @@ def run_bench(fast: bool):
         # The chip the numbers came from (jax device_kind); rounds from
         # different chips never ratchet against each other.
         "device_kind": mk.group(1).strip() if mk else "unknown",
+        # Whether the suite ran bench.py --tuned (TuneCache winners
+        # next to the default rows). Tuned and default rounds are
+        # different experiments: a tuned round ratcheting a default
+        # baseline would bake the tuner's win into the floor.
+        "tuned": bool(headline.get("tuned")),
         # Reproducibility stamp, NOT a gate key (comparable() never
         # reads it): the flag-registry hash that keys this round's run
         # ledger records, so a gate artifact can be joined back to its

@@ -15,6 +15,11 @@ two most recent cohorts (or the ``--a``/``--b`` hashes), and report
 - which flags differ between the cohorts' stored config snapshots
   (path-kind flags excluded: artifact sinks, not behavior).
 
+``--tuned`` recognizes cohort pairs whose config diff is entirely
+tuner-managed flags (lux_tpu/tune/space.py TUNER_MANAGED) and reports
+them as one "tuned config" decision — the auto-tuner's selection —
+instead of listing the raw knob diff.
+
 ``--bench A.json B.json`` additionally diffs two bench round artifacts
 (BENCH_r0N.json lineage: headline + suite gteps) through the same
 tolerance. Output is a human report on stdout; ``--json`` emits one
@@ -127,8 +132,22 @@ def config_diff(a_recs, b_recs) -> dict:
     return out
 
 
+def tuned_config_diff(diff: dict) -> bool:
+    """True when the cohorts differ ONLY in tuner-managed flags
+    (lux_tpu/tune/space.py TUNER_MANAGED) — i.e. the delta between them
+    IS the auto-tuner's doing (a tuned-vs-default pair, or two tuned
+    configs), not a code or environment change. LUX_ENGOBS is also
+    tuner-set: probes force phase measurement on."""
+    from lux_tpu.tune.space import TUNER_MANAGED
+
+    if not diff:
+        return False
+    return set(diff) <= (TUNER_MANAGED | {"LUX_ENGOBS"})
+
+
 def compare(a_recs, b_recs, tol: float) -> dict:
     a_m, b_m = aggregate(a_recs), aggregate(b_recs)
+    diff = config_diff(a_recs, b_recs)
     regressions, improvements = [], []
     for path, hib in METRICS:
         av, bv = a_m.get(path), b_m.get(path)
@@ -166,7 +185,8 @@ def compare(a_recs, b_recs, tol: float) -> dict:
         "improvements": improvements,
         "phase": phase,
         "phase_delta_s": round(phase_delta, 6) if phase else None,
-        "config_diff": config_diff(a_recs, b_recs),
+        "config_diff": diff,
+        "tuned_config": tuned_config_diff(diff),
     }
 
 
@@ -245,10 +265,35 @@ def render(report: dict) -> str:
                 lines.append(
                     "      responsible phase: {} ({:+.6f}s)".format(
                         reg["phase"], pair["phase_delta_s"] or 0.0))
-        for name, d in pair["config_diff"].items():
+        if report.get("tuned_mode"):
+            # The tuned-vs-default report cuts both ways: what the
+            # selection bought is as load-bearing as what it cost.
+            for imp in pair.get("improvements") or ():
+                lines.append(
+                    "    IMPROVED {metric}: {a:.6g} -> {b:.6g} "
+                    "({delta_frac:+.1%})".format(**imp))
+        if report.get("tuned_mode") and pair.get("tuned_config"):
+            # The cohorts differ only in tuner-managed flags: the delta
+            # IS the tuner's selection, so name it as one decision
+            # instead of spelling out the raw knob diff.
+            knobs = ", ".join(
+                "{}={!r}".format(n, d["b"])
+                for n, d in sorted(pair["config_diff"].items())
+                if n != "LUX_ENGOBS")
             lines.append(
-                "      config diff: {}: {!r} -> {!r}".format(
-                    name, d["a"], d["b"]))
+                "      tuned config: cohorts differ only in "
+                "tuner-managed flags — B is the auto-tuner's "
+                "selection ({})".format(knobs or "defaults"))
+        else:
+            for name, d in pair["config_diff"].items():
+                lines.append(
+                    "      config diff: {}: {!r} -> {!r}".format(
+                        name, d["a"], d["b"]))
+            if pair.get("tuned_config"):
+                lines.append(
+                    "      (all tuner-managed: a tuned-vs-default "
+                    "cohort pair — rerun with --tuned for the "
+                    "attribution line)")
         if pair["regressions"] and not pair["config_diff"]:
             lines.append("      config diff: none (same flags — suspect "
                          "the code or the environment, not a knob)")
@@ -280,6 +325,10 @@ def main(argv=None) -> int:
                    help="relative move past which a metric counts")
     p.add_argument("--bench", nargs=2, metavar=("A.json", "B.json"),
                    help="also diff two bench round artifacts")
+    p.add_argument("--tuned", action="store_true",
+                   help="attribute cohort pairs that differ only in "
+                   "tuner-managed flags (lux_tpu/tune) as one 'tuned "
+                   "config' decision instead of a raw flag diff")
     p.add_argument("--json", action="store_true",
                    help="emit one doctor.v1 JSON line instead of text")
     args = p.parse_args(argv)
@@ -298,6 +347,7 @@ def main(argv=None) -> int:
         "dir": root,
         "records": len(records),
         "tol": args.tol,
+        "tuned_mode": bool(args.tuned),
         "pairs": pairs,
         "validate": ledger.validate_dir(root),
     }

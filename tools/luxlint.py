@@ -21,6 +21,9 @@ Usage:
                                              #   + collective dataflow (LUX4xx)
     python tools/luxlint.py --exchange DIR   # verify saved exchange-plan
                                              #   artifacts / fixture modules
+    python tools/luxlint.py --tune DIR...    # verify saved tuneconf.v1
+                                             #   auto-tuner artifacts
+                                             #   (LUX5xx, jax-free)
     python tools/luxlint.py --baseline F     # snapshot/compare: only findings
                                              #   absent from F fail the run
 
@@ -185,6 +188,15 @@ def _run_plans(paths, select: str):
     return planck.verify_plan_dirs(paths, rules)
 
 
+def _run_tune(paths, select: str):
+    from lux_tpu.analysis import tuneck
+    rules = tuneck.all_tune_rules()
+    if select:
+        want = {s.strip() for s in select.split(",") if s.strip()}
+        rules = [r for r in rules if r.id in want]
+    return tuneck.verify_artifact_paths(paths, rules)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="luxlint", description=__doc__)
     ap.add_argument("paths", nargs="*",
@@ -215,6 +227,10 @@ def main(argv=None) -> int:
                          "accounting dataflow rules over every sharded "
                          "registry target; with paths, verify saved "
                          "exchange artifacts or fixture modules")
+    ap.add_argument("--tune", action="store_true",
+                    help="verify saved tuneconf.v1 auto-tuner artifacts "
+                         "(LUX501-504: structure, knob domains, selection "
+                         "consistency, staleness; jax-free)")
     ap.add_argument("--changed", action="store_true",
                     help="AST/threads tiers: restrict to .py files changed "
                          "vs git HEAD (plus untracked); the threads tier "
@@ -225,9 +241,10 @@ def main(argv=None) -> int:
                          "and pass; if present, fail only on new findings")
     args = ap.parse_args(argv)
 
-    if sum((args.ir, args.plans, args.threads, args.exchange)) > 1:
-        ap.error("--ir, --plans, --threads, and --exchange are separate "
-                 "tiers; run them separately")
+    if sum((args.ir, args.plans, args.threads, args.exchange,
+            args.tune)) > 1:
+        ap.error("--ir, --plans, --threads, --exchange, and --tune are "
+                 "separate tiers; run them separately")
 
     if args.list_rules:
         for r in all_rules():
@@ -245,6 +262,12 @@ def main(argv=None) -> int:
         try:
             from lux_tpu.analysis import exchck
             for r in exchck.all_exchange_rules():
+                print(f"{r.id}  {r.title}\n       {r.doc}")
+        except Exception:
+            pass
+        try:
+            from lux_tpu.analysis import tuneck
+            for r in tuneck.all_tune_rules():
                 print(f"{r.id}  {r.title}\n       {r.doc}")
         except Exception:
             pass
@@ -279,6 +302,11 @@ def main(argv=None) -> int:
         if not args.paths:
             ap.error("--plans requires at least one artifact directory")
         report = _run_plans(args.paths, args.select)
+    elif args.tune:
+        if not args.paths:
+            ap.error("--tune requires at least one artifact file or "
+                     "directory")
+        report = _run_tune(args.paths, args.select)
     elif args.threads:
         select = None
         if args.select:

@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange lint-programs plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -28,13 +28,21 @@ lint-threads:
 lint-exchange:
 	python tools/luxlint.py --exchange
 
+# Program-contract tier: prove each registered program's combiner
+# identity/exactness, push/pull duality, frontier annihilation, and
+# monotone convergence (LUX601-606), assert parity between the derived
+# gascap.v1 capability matrix and the committed artifact, and show a
+# seeded broken program is caught — all inside a 2s wall budget.
+lint-programs:
+	env JAX_PLATFORMS=cpu python tools/gasck_smoke.py
+
 plan-check:
 	python tools/plan_check.py
 
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange lint-programs plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py

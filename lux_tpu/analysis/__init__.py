@@ -11,7 +11,14 @@ the tree in well under a second per file):
 - :mod:`lux_tpu.analysis.threads` — the concurrency tier (LUX301-305):
   thread-shared state vs lock guards, the cross-file lock-order graph,
   blocking-under-lock, unjoined threads, and atomic-publish discipline.
-  Its runtime twin is ``lux_tpu/utils/locks.py`` (LockWatch).
+  Its runtime twin is ``lux_tpu/utils/locks.py`` (LockWatch);
+- :mod:`lux_tpu.analysis.gasck` — the program-algebra tier (LUX601-606,
+  ``luxlint --programs``): proves each registry program's combiner
+  identity/exactness, push<->pull duality, monotone convergence, and
+  frontier annihilation on seeded probes, derives the capability matrix
+  as a content-addressed ``gascap.v1`` artifact, and flags declaration
+  drift. numpy at import; jax only through the program hooks (import it
+  lazily from stdlib-only callers).
 
 Runtime side (imports jax; import it lazily):
 

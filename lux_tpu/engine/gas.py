@@ -99,6 +99,26 @@ class GasProgram:
     rooted: bool = False            # takes a per-query `start` root
     servable: bool = True           # exposed through serve/session.py
     frontier: bool = True           # False => fixed-iteration dense pull
+    # Capability declarations machine-checked by ``luxlint --programs``
+    # (analysis/gasck.py, LUX601-606): frontier_ok licenses the masked
+    # pull / sentinel-padded frontier exchange (the identity must
+    # annihilate and the push/pull traces must agree bitwise);
+    # incremental_ok licenses the warm-started refresh
+    # (engine/incremental.py — requires the monotone-merge proof plus a
+    # host ``relax`` hook). Declaring either without the proof is a
+    # LUX604/LUX606 finding, so these are claims, not configuration.
+    frontier_ok: bool = True
+    incremental_ok: bool = False
+
+    # Optional algebra/direction overrides. ``combine``/``combine_identity``
+    # state the combiner's scalar semantics when they are not the declared
+    # built-in (the prover cross-checks them against ``combiner`` —
+    # LUX602); ``gather_push`` specializes the push-direction edge
+    # function, which is only sound if LUX603 proves the traces still
+    # bitwise-equal. None means "use the default".
+    combine = None           # combine(a, b) -> combined value
+    combine_identity = None  # combine_identity(dtype) -> identity scalar
+    gather_push = None       # gather_push(src_vals, weights) -> messages
 
     # -- frontier-program hooks ------------------------------------------
 
@@ -168,6 +188,8 @@ class PushGasAdapter(GasProgram):
         self.value_dtype = inner.value_dtype
         self.needs_weights = inner.needs_weights
         self.rooted = getattr(inner, "rooted", False)
+        self.frontier_ok = getattr(inner, "frontier_ok", True)
+        self.incremental_ok = getattr(inner, "incremental_ok", False)
 
     def init_values(self, graph: Graph, **kw) -> np.ndarray:
         return self.inner.init_values(graph, **kw)
@@ -186,6 +208,7 @@ class PullGasAdapter(GasProgram):
 
     frontier = False
     servable = False     # pagerank/colfilter keep their pull serving path
+    frontier_ok = False  # no frontier machinery => no annihilation claim
 
     def __init__(self, inner: PullProgram):
         self.inner = inner
@@ -357,7 +380,8 @@ class AdaptiveExecutor:
         dst = dg["csr_col_dst"][edge_pos]
         src_vals = state.values[jnp.clip(q[slot], 0, nv - 1)]
         w = dg["csr_weights"][edge_pos] if "csr_weights" in dg else None
-        msg = prog.gather(src_vals, w)
+        gather = getattr(prog, "gather_push", None) or prog.gather
+        msg = gather(src_vals, w)
         ident = identity_for(prog.combiner, msg.dtype)
         msg = jnp.where(emask, msg, ident)
         dst = jnp.where(emask, dst, 0)

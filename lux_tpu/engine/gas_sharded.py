@@ -377,7 +377,8 @@ class ShardedAdaptiveExecutor:
             dg["push_weights"][0][edge_pos]
             if "push_weights" in dg else None
         )
-        msg = prog.gather(all_qv[slot], w)
+        gather = getattr(prog, "gather_push", None) or prog.gather
+        msg = gather(all_qv[slot], w)
         ident = identity_for(prog.combiner, msg.dtype)
         msg = jnp.where(emask, msg, ident)
         dstl = jnp.where(emask, dstl, max_nv)

@@ -20,18 +20,18 @@ Invalidation (the only subtle part) is per monotone-combiner program
 - Reset vertices restart from their init values; everything else keeps
   its old fixpoint value.
 
-Why this is sufficient (min-combiner; max is symmetric with the
-inequalities flipped): every non-reset vertex retains a support chain of
-surviving, non-reset vertices realizing its old value — had any chain
-link been removed or reset, the seed rule or the BFS would have reset it
-too (``old`` is an exact old-graph fixpoint, so ``old[v] ==
-relax(old[p], w)`` holds along the chain and the support test fires).
-Hence every warm value is achievable in the new graph — pointwise >= the
-true new fixpoint but attainable — and monotone push iteration from the
-warm frontier (reset vertices + their new-graph in-neighbors + insert
-sources, i.e. every vertex whose push could first lower a neighbor)
-converges to exactly the full-recompute fixpoint. Parity is therefore
-*bitwise* for integral apps; tests/test_incremental.py asserts it
+Soundness is no longer argued here by hand — it is machine-checked.
+The sketch: every non-reset vertex retains a support chain realizing
+its old value, so warm values are pointwise-achievable in the new
+graph, and a *monotone* push iteration from the warm frontier converges
+to exactly the full-recompute fixpoint. The load-bearing premises —
+idempotent monotone merge, ``apply`` == combiner merge, inflationary
+and monotone ``relax`` — are exactly the LUX604 monotone-convergence
+proof ``luxlint --programs`` runs offline (analysis/gasck.py), and
+:class:`IncrementalExecutor` refuses construction with a typed
+:class:`~lux_tpu.analysis.gasck.ProgramContractError` naming the failed
+sub-check for any program that does not carry the proof.
+tests/test_incremental.py still asserts the end result: bitwise parity
 against from-scratch runs and host oracles.
 
 PageRank is not a monotone push program; :func:`incremental_pagerank`
@@ -158,6 +158,12 @@ class IncrementalExecutor:
     def __init__(self, graph: Graph, program, push: Optional[PushExecutor] = None,
                  multi: Optional[MultiSourcePushExecutor] = None,
                  k: Optional[int] = None, device=None):
+        # The warm-start argument above holds only for programs with the
+        # LUX604 monotone-convergence proof; this raises
+        # ProgramContractError (naming the failed sub-check) otherwise.
+        from lux_tpu.analysis.gasck import require_incremental
+
+        require_incremental(program)
         self.graph = graph
         self.program = program
         self.device = device

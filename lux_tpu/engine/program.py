@@ -57,6 +57,12 @@ class PullProgram:
     needs_weights: bool = False
     rooted: bool = False              # takes a per-query `start` root
     servable: bool = True             # exposed through serve/session.py
+    # Machine-checked capability claims (luxlint --programs, LUX606):
+    # pull programs run dense fixed-iteration sweeps, so neither the
+    # frontier-annihilation license nor the incremental warm-start
+    # applies by default.
+    frontier_ok: bool = False
+    incremental_ok: bool = False
     # True iff edge_contrib(e) == e.src_vals (an SpMV-shaped iteration);
     # unlocks the MXU tiled-hybrid executor (engine/tiled.py).
     identity_contrib: bool = False

@@ -71,6 +71,12 @@ class PushProgram:
     needs_weights: bool = False
     rooted: bool = False           # takes a per-query `start` root
     servable: bool = True          # exposed through serve/session.py
+    # Machine-checked capability claims (luxlint --programs, LUX604/606):
+    # frontier_ok licenses the masked-identity frontier machinery above;
+    # incremental_ok additionally claims the monotone-merge proof that
+    # engine/incremental.py's warm-start depends on.
+    frontier_ok: bool = True
+    incremental_ok: bool = False
     # Declare True iff every value the program can ever hold fits in 31
     # bits (e.g. SSSP distances and CC labels, both <= nv < 2^31). The
     # blocked dense path packs the frontier bit into the value's top bit

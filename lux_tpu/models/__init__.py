@@ -28,12 +28,85 @@ PROGRAMS = {
     "kcore": KCore,
 }
 
-# Derived from each program's ``rooted`` declaration so a new rooted
-# program can't silently miss multi-source batching by not being added
-# to a hand-maintained set here.
-ROOTED_APPS = frozenset(
-    name for name, cls in PROGRAMS.items() if getattr(cls, "rooted", False)
-)
+_CAPABILITY_REPORT = None
+
+
+def capability_report(refresh: bool = False) -> dict:
+    """The machine-checked capability matrix the registry trusts.
+
+    Prefers the derived proof matrix from the ``gascap.v1`` artifact
+    (``luxlint --programs``, analysis/gasck.py — honoring
+    ``LUX_GASCAP_DIR``); falls back to the class-attr declarations when
+    the artifact is missing or rejected (tampered artifacts raise inside
+    gasck and land here as ``error``). Returns ``{source, artifact_id,
+    error, programs: {name: {rooted, frontier_ok, incremental_ok}}}``
+    with ``source`` one of ``artifact`` / ``declared``.
+    """
+    global _CAPABILITY_REPORT
+    if _CAPABILITY_REPORT is not None and not refresh:
+        return _CAPABILITY_REPORT
+    declared = {
+        name: {
+            "rooted": bool(getattr(cls, "rooted", False)),
+            "frontier_ok": bool(getattr(cls, "frontier_ok", False)),
+            "incremental_ok": bool(getattr(cls, "incremental_ok", False)),
+        }
+        for name, cls in PROGRAMS.items()
+    }
+    report = {"source": "declared", "artifact_id": None, "error": None,
+              "programs": declared}
+    try:
+        from lux_tpu.analysis import gasck
+
+        art = gasck.load_capmap(gasck.capmap_path())
+        programs = {}
+        for name, caps in declared.items():
+            entry = (art.get("programs") or {}).get(name)
+            derived = entry.get("derived") if isinstance(entry, dict) \
+                else None
+            if isinstance(derived, dict):
+                programs[name] = {
+                    k: bool(derived.get(k, caps[k])) for k in caps
+                }
+            else:
+                programs[name] = caps   # program newer than the artifact
+        report = {"source": "artifact", "artifact_id": art.get("id"),
+                  "error": None, "programs": programs}
+    except FileNotFoundError:
+        report["error"] = "artifact missing (run luxlint --programs)"
+    except Exception as e:
+        report["error"] = f"artifact rejected: {e!r}"
+    _CAPABILITY_REPORT = report
+    return report
+
+
+def capabilities(refresh: bool = False) -> dict:
+    """``{name: {rooted, frontier_ok, incremental_ok}}`` per program."""
+    return capability_report(refresh)["programs"]
+
+
+def frontier_ok(name: str) -> bool:
+    """Proof-derived license for the frontier exchange / adaptive lanes."""
+    return bool(capabilities().get(name, {}).get("frontier_ok", False))
+
+
+def incremental_ok(name: str) -> bool:
+    """Proof-derived license for IncrementalExecutor warm-starts."""
+    return bool(capabilities().get(name, {}).get("incremental_ok", False))
+
+
+def rooted_apps() -> frozenset:
+    return frozenset(
+        name for name, caps in capabilities().items() if caps["rooted"]
+    )
+
+
+# Derived from the gascap.v1 proof matrix (class-attr declarations as
+# the no-artifact fallback) so a new rooted program can't silently miss
+# multi-source batching by not being added to a hand-maintained set —
+# and so a *claimed* root parameter that init_values ignores can't buy
+# batching it can't serve (LUX606 keeps the two views in lockstep).
+ROOTED_APPS = rooted_apps()
 
 # Which executor kinds can run each program (the luxlint-IR trace
 # matrix, analysis/ir.py — and the capability map cli/serve consult).
@@ -92,6 +165,11 @@ __all__ = [
     "PROGRAMS",
     "ROOTED_APPS",
     "ENGINE_KINDS",
+    "capability_report",
+    "capabilities",
+    "frontier_ok",
+    "incremental_ok",
+    "rooted_apps",
     "engine_kinds",
     "get_program",
 ]

@@ -22,6 +22,7 @@ class ConnectedComponents(PushProgram):
     combiner = "max"
     value_dtype = jnp.uint32
     packable_values = True     # labels < nv < 2^31
+    incremental_ok = True      # monotone max-merge, proven by LUX604
 
     def init_values(self, graph: Graph, **kw) -> np.ndarray:
         return np.arange(graph.nv, dtype=np.uint32)

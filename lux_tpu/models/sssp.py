@@ -23,6 +23,7 @@ class SSSP(PushProgram):
     value_dtype = jnp.uint32
     rooted = True
     packable_values = True     # distances <= nv < 2^31
+    incremental_ok = True      # monotone min-merge, proven by LUX604
 
     def init_values(self, graph: Graph, start: int = 0) -> np.ndarray:
         dist = np.full(graph.nv, graph.nv, dtype=np.uint32)  # ∞ == nv

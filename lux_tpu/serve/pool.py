@@ -37,6 +37,7 @@ class EnginePool:
         # dashboards alert on this going nonzero, not on its absence.
         self._ir_findings = metrics.counter("lux_ir_findings_total")
         self._exch_findings = metrics.counter("lux_exch_findings_total")
+        self._gas_findings = metrics.counter("lux_gas_findings_total")
         self._retired = metrics.counter("lux_serve_pool_retired_total")
         self.sentinel = RecompileSentinel(scope)
 
@@ -69,6 +70,7 @@ class EnginePool:
                         ex.warmup()
             self._audit(key, ex)
             self._audit_exchange(key, ex)
+            self._audit_programs(key, ex)
             self._engines[key] = ex
             return ex
 
@@ -107,6 +109,29 @@ class EnginePool:
             self._exch_findings.inc()
             print(f"EnginePool: {f.format()}")
 
+    def _audit_programs(self, key: Hashable, ex) -> None:
+        """LUX601/602/605 program-algebra audit on the freshly built
+        engine: probe-grid identity/exactness/annihilation in host
+        numpy, no graph trace (gasck caches per program identity, so
+        the k-th engine for a program costs a dict lookup). A finding
+        means the combiner algebra this engine's masking and sharded
+        accumulation rely on does not actually hold — flagged once at
+        build time (``lux_gas_findings_total``), never per query."""
+        if not flags.get_bool("LUX_GAS_POOL_AUDIT"):
+            return
+        prog = getattr(ex, "program", None)
+        if prog is None:
+            return
+        from lux_tpu.analysis import gasck
+        try:
+            findings = gasck.audit_program(prog, f"pool@{key}")
+        # luxlint: disable=LUX007 -- advisory audit: a failed probe must never take down a build
+        except Exception:
+            return
+        for f in findings:
+            self._gas_findings.inc()
+            print(f"EnginePool: {f.format()}")
+
     def retire(self, predicate: Callable[[Hashable], bool]) -> int:
         """Drop every engine whose key satisfies ``predicate`` (hot-swap:
         the session retires all engines keyed by the outgoing snapshot's
@@ -141,6 +166,7 @@ class EnginePool:
             "recompiles": self.sentinel.recompiles(),
             "ir_findings": int(self._ir_findings.value),
             "exch_findings": int(self._exch_findings.value),
+            "gas_findings": int(self._gas_findings.value),
         }
 
     def close(self):

@@ -342,7 +342,10 @@ class Session:
         from lux_tpu.engine.gas import GasProgram
         from lux_tpu.models import PROGRAMS
 
+        from lux_tpu.models import capabilities
+
         weighted = self._serving.graph.weighted
+        caps = capabilities()
         legacy = list(Session.APPS)
         apps, rooted, fixpoints = [], [], []
         for name in legacy + sorted(set(PROGRAMS) - set(legacy)):
@@ -357,7 +360,13 @@ class Session:
             if not issubclass(cls, GasProgram):
                 continue   # no GAS route for it; not served
             apps.append(name)
-            if getattr(cls, "rooted", False):
+            # Rooted routing (multi-source batching vs result-cache
+            # fixpoints) follows the gascap.v1 proof matrix, not the
+            # class attr — a claimed root init_values ignores must not
+            # buy per-query batching it can't serve (LUX606 keeps the
+            # declaration honest offline).
+            if caps.get(name, {}).get("rooted",
+                                      getattr(cls, "rooted", False)):
                 rooted.append(name)
             else:
                 fixpoints.append(name)
@@ -496,6 +505,22 @@ class Session:
             "cache": tune_cache().stats(),
         }
 
+    def _programs_block(self) -> dict:
+        """The /statusz ``programs`` view: where routing's capability
+        matrix came from (gascap.v1 artifact id, or the declared-attr
+        fallback plus why), the per-program derived bits, and the pool's
+        advisory build-time audit count."""
+        from lux_tpu.models import capability_report
+
+        rep = capability_report()
+        return {
+            "source": rep["source"],
+            "artifact_id": rep["artifact_id"],
+            **({"error": rep["error"]} if rep.get("error") else {}),
+            "capabilities": rep["programs"],
+            "gas_findings": self.pool.stats()["gas_findings"],
+        }
+
     def _tuned_build(self, app: str, snap: Snapshot, build):
         """Wrap an engine builder so every pool miss — warmup, a
         breaker rebuild, the first use of a sibling key — constructs
@@ -581,6 +606,18 @@ class Session:
         # knobs (all capture-at-build) are baked into the warm
         # executables and the query path compiles nothing new.
         tuned = self._load_tuned(snap)
+        # Resolve the program capability matrix once, loudly, before
+        # traffic: a missing/rejected gascap.v1 artifact demotes routing
+        # to the class-attr declarations, and that demotion belongs in
+        # the warmup log — not discovered query-by-query.
+        from lux_tpu.models import capability_report
+        caps = capability_report()
+        if caps.get("error"):
+            self.log.warning("program capabilities: declared fallback "
+                             "(%s)", caps["error"])
+        else:
+            self.log.info("program capabilities: %s %s", caps["source"],
+                          caps.get("artifact_id"))
         with spans.span("serve.warmup", version=snap.version):
             faults.point("snapshot.warm")
             with _timed(self.log, "warmup sssp single"):
@@ -1433,6 +1470,7 @@ class Session:
         """
         from lux_tpu.engine.incremental import IncrementalExecutor
         from lux_tpu.graph.delta import removed_edges
+        from lux_tpu.models import incremental_ok
         from lux_tpu.models.components import ConnectedComponents
         from lux_tpu.models.sssp import SSSP
 
@@ -1441,7 +1479,13 @@ class Session:
         out = {"components": 0, "sssp": 0, "touched_frac": None}
 
         with spans.span("serve.incremental_refresh", version=snap.version):
-            cc_hit = self.cache.get((old.fingerprint, "components"))
+            # Warm-start eligibility is the LUX604 monotone-convergence
+            # proof (gascap.v1 via models.incremental_ok), not this
+            # method's opinion — a program whose proof lapsed falls back
+            # to the cold recompute path instead of tripping the
+            # IncrementalExecutor contract gate mid-swap.
+            cc_hit = (self.cache.get((old.fingerprint, "components"))
+                      if incremental_ok("components") else None)
             if cc_hit is not None:
                 ex = self._components_engine(snap)
                 inc = IncrementalExecutor(
@@ -1466,7 +1510,7 @@ class Session:
                 k[2] for k in self.cache.keys()
                 if isinstance(k, tuple) and len(k) == 3
                 and k[0] == old.fingerprint and k[1] == "sssp"
-            ]
+            ] if incremental_ok("sssp") else []
             if roots:
                 k_w = self.config.max_batch
                 multi = self._sssp_multi(snap)
@@ -1670,6 +1714,7 @@ class Session:
             "batcher": self.batcher.stats(),
             "mesh": self._mesh_block(),
             "tune": self._tune_block(),
+            "programs": self._programs_block(),
             "requests": int(self._requests.value),
         }
         if self._latency.count:
@@ -1709,6 +1754,7 @@ class Session:
             "batch_size": self.batcher.batch_histogram(),
             "mesh": self._mesh_block(),
             "tune": self._tune_block(),
+            "programs": self._programs_block(),
             # Latest adaptive-executor direction split (push/pull iters,
             # mid-run switches) per GAS engine kind; {} until one runs.
             "gas": {kind: rec for kind, rec in engobs.latest().items()
@@ -1720,6 +1766,7 @@ class Session:
                 "warmup_compiles": p["warmup_compiles"],
                 "recompiles": p["recompiles"],
                 "ir_findings": p["ir_findings"],
+                "gas_findings": p["gas_findings"],
             },
             "flight": flight.counts(),
         }

@@ -353,6 +353,23 @@ define("LUX_EXCH_POOL_AUDIT", True,
        "run the LUX401-403 exchange-plan audit on every plan-carrying "
        "engine the serve pool builds (pure numpy over the live "
        "ExchangePlan tables; 0 disables)", kind="bool")
+define("LUX_GASCAP_DIR", None,
+       "directory holding the gascap.v1 program-capability artifact "
+       "(analysis/gasck.py) the registry/serving layers consult; unset = "
+       "the committed lux_tpu/analysis/gascap.json", kind="path")
+define("LUX_GAS_POOL_AUDIT", True,
+       "run the LUX601/602/605 program-algebra audit on every "
+       "GAS-program-carrying engine the serve pool builds (cached "
+       "per program class; 0 disables)", kind="bool")
+define("LUX_GASCK_SEED", 7,
+       "luxlint --programs: RNG seed for the probe graphs and the "
+       "LUX602 associativity/commutativity probe triples", kind="int")
+define("LUX_GASCK_TRIPLES", 64,
+       "luxlint --programs: number of seeded probe triples per program "
+       "for the LUX602 combiner-algebra proof", kind="int")
+define("LUX_GASCK_NV", 24,
+       "luxlint --programs: vertex count of the seeded probe graphs the "
+       "LUX603 push/pull duality traces run on", kind="int")
 
 # Concurrency discipline (utils/locks.py, tools/race_stress.py)
 define("LUX_LOCKWATCH", False,

@@ -1,0 +1,506 @@
+"""luxlint program-contract tier: the LUX601-606 prover (gasck), the
+gascap.v1 capability artifact, the capability-derived registry/serving
+surfaces, the IncrementalExecutor contract gate, the serve-pool audit
+hook, and the --programs CLI.
+
+Seeded-violation convention (tests/gas_fixtures/): each ``lux6NN_*.py``
+module defines one broken program and must make ``luxlint --programs``
+exit 1 with exactly its own rule firing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from lux_tpu.analysis import gasck  # noqa: E402
+from lux_tpu.analysis.gasck import ProgramContractError  # noqa: E402
+from lux_tpu.engine.gas import AdaptiveExecutor, GasProgram  # noqa: E402
+from lux_tpu.engine.incremental import IncrementalExecutor  # noqa: E402
+from lux_tpu.graph.graph import Graph  # noqa: E402
+from lux_tpu.models.bfs import BFS  # noqa: E402
+from lux_tpu.models.components import ConnectedComponents  # noqa: E402
+from lux_tpu.models.sssp import SSSP  # noqa: E402
+from lux_tpu.models.sssp_delta import DeltaSSSP  # noqa: E402
+from lux_tpu.serve.pool import EnginePool  # noqa: E402
+from lux_tpu.utils import flags  # noqa: E402
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+LUXLINT = os.path.join(REPO, "tools", "luxlint.py")
+GAS_FIXTURES = os.path.join(TESTS, "gas_fixtures")
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, LUXLINT, *argv],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def _summary_line(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("LUXLINT ")]
+    assert lines, stdout
+    return json.loads(lines[-1][len("LUXLINT "):])
+
+
+def _rules(report):
+    return sorted({f.rule for f in report.findings})
+
+
+def _ring(nv=12):
+    src = np.arange(nv, dtype=np.int64)
+    return Graph.from_edges(src, (src + 1) % nv, nv)
+
+
+@pytest.fixture(scope="module")
+def registry():
+    """One registry prove shared by the matrix assertions (~1s)."""
+    return gasck.prove_registry()
+
+
+# -- probe-grid + scalar-proof units --------------------------------------
+
+
+def test_probe_grid_extremes_and_hygiene():
+    grid = gasck._probe_grid(
+        np.array([3.0, -0.0, np.nan], dtype=np.float32),
+        np.float32(0.0), np.dtype(np.float32), seed=7)
+    assert np.inf in grid and -np.inf in grid
+    assert np.finfo(np.float32).max in grid
+    assert not np.isnan(grid).any()          # NaN has its own policy probe
+    assert not ((grid == 0) & np.signbit(grid)).any()   # no -0.0
+
+
+def test_identity_check_rejects_zero_for_min():
+    probes = gasck._probe_grid(np.array([], dtype=np.uint32),
+                               np.uint32(0), np.dtype(np.uint32), seed=7)
+    ok, msg, _ = gasck._check_identity(
+        np.minimum, np.uint32(0), probes, np.dtype(np.uint32))
+    assert not ok and "p=" in msg
+
+
+def test_identity_check_accepts_engine_identities():
+    for combiner, dtype in (("min", np.uint32), ("sum", np.float32),
+                            ("max", np.uint32), ("min", np.float32)):
+        ident = gasck._identity_np(combiner, np.dtype(dtype))
+        probes = gasck._probe_grid(np.array([], dtype=dtype), ident,
+                                   np.dtype(dtype), seed=7)
+        ok, msg, _ = gasck._check_identity(
+            gasck._np_op(combiner), ident, probes, np.dtype(dtype))
+        assert ok, (combiner, dtype, msg)
+
+
+def test_identity_check_flags_asymmetric_nan_policy():
+    def lopsided(a, b):
+        # NaN is absorbed only from the right operand: push and pull
+        # would disagree as soon as edge order differs.
+        return np.where(np.isnan(np.asarray(b)), a, np.minimum(a, b))
+    probes = np.array([0.0, 1.0], dtype=np.float32)
+    ok, msg, _ = gasck._check_identity(
+        lopsided, np.float32(np.inf), probes, np.dtype(np.float32))
+    assert not ok and "NaN" in msg
+
+
+def test_algebra_float_sum_is_inexact():
+    probes = gasck._probe_grid(np.array([], dtype=np.float32),
+                               np.float32(0), np.dtype(np.float32), seed=7)
+    ok, msg = gasck._check_algebra(np.add, probes, seed=7, triples=16)
+    assert not ok and "associative" in msg
+
+
+def test_algebra_uint_sum_and_minmax_are_exact():
+    for op, dtype in ((np.add, np.uint32), (np.minimum, np.uint32),
+                      (np.maximum, np.uint32), (np.minimum, np.float32)):
+        ident = np.array(0, dtype=dtype)[()]
+        probes = gasck._probe_grid(np.array([], dtype=dtype), ident,
+                                   np.dtype(dtype), seed=7)
+        ok, msg = gasck._check_algebra(op, probes, seed=7, triples=32)
+        assert ok, (op.__name__, dtype, msg)
+
+
+def test_derive_rooted_from_init_hooks():
+    g = gasck._seed_graphs(16, 7)["plain"]
+    assert gasck._derive_rooted(BFS(), g)
+    assert not gasck._derive_rooted(ConnectedComponents(), g)
+
+
+# -- registry proof + derived matrix --------------------------------------
+
+
+def test_registry_proves_clean(registry):
+    report, _ = registry
+    assert report.ok
+    assert report.schema == "luxlint-programs.v1"
+    assert len(report.results) == 8
+    assert not any(r.error for r in report.results)
+
+
+def test_registry_derived_matrix(registry):
+    _, art = registry
+    derived = {n: c["derived"] for n, c in art["programs"].items()}
+    assert derived["sssp"] == {"rooted": True, "frontier_ok": True,
+                               "incremental_ok": True}
+    assert derived["components"]["incremental_ok"]
+    assert derived["bfs"]["rooted"] and derived["bfs"]["frontier_ok"]
+    assert derived["sssp_delta"] == {"rooted": True, "frontier_ok": True,
+                                     "incremental_ok": False}
+    # Dense pull programs earn no frontier license.
+    assert not derived["pagerank"]["frontier_ok"]
+    assert not derived["colfilter"]["frontier_ok"]
+    assert {n for n, d in derived.items() if d["frontier_ok"]} == {
+        "bfs", "components", "kcore", "labelprop", "sssp", "sssp_delta"}
+
+
+def test_registry_matches_committed_artifact(registry):
+    """The LUX606 offline ratchet: a capability-changing edit must
+    regenerate lux_tpu/analysis/gascap.json or verify fails."""
+    _, art = registry
+    committed = gasck.load_capmap(gasck.capmap_path())
+    assert committed["id"] == art["id"]
+
+
+# -- seeded fixtures: each fails with exactly its rule --------------------
+
+
+@pytest.mark.parametrize("stem,rule", [
+    ("lux601_bad_identity", "LUX601"),
+    ("lux602_inexact_sum", "LUX602"),
+    ("lux603_push_pull_skew", "LUX603"),
+    ("lux604_nonmonotone_incremental", "LUX604"),
+    ("lux605_clobbering_apply", "LUX605"),
+    ("lux606_overclaimed_frontier", "LUX606"),
+])
+def test_fixture_fails_with_exactly_its_rule(stem, rule):
+    path = os.path.join(GAS_FIXTURES, stem + ".py")
+    report = gasck.verify_fixture_paths([path])
+    assert not report.ok
+    assert _rules(report) == [rule]
+    assert not any(r.error for r in report.results)
+
+
+def test_fixture_select_filters_rules():
+    path = os.path.join(GAS_FIXTURES, "lux602_inexact_sum.py")
+    report = gasck.verify_fixture_paths([path], select=("LUX601",))
+    assert report.ok    # the LUX602 finding is filtered out
+
+
+# -- the gather_push seam the prover licenses -----------------------------
+
+
+def test_engine_push_path_consumes_gather_push():
+    """LUX603 exists because the engines really do run gather_push on
+    the push branch: a skewed override makes pinned-push diverge from
+    pinned-pull, and an equal override keeps them bitwise identical."""
+    class Skewed(GasProgram):
+        name = "skewed"
+        servable = False
+        frontier_ok = False
+
+        def init_values(self, graph, **kw):
+            v = np.full(graph.nv, graph.nv, dtype=np.uint32)
+            v[0] = 0
+            return v
+
+        def init_frontier(self, graph, **kw):
+            f = np.zeros(graph.nv, dtype=bool)
+            f[0] = True
+            return f
+
+        def gather(self, src_vals, weights):
+            return src_vals + np.uint32(1)
+
+        def gather_push(self, src_vals, weights):
+            return src_vals + np.uint32(2)
+
+    class Aligned(Skewed):
+        name = "aligned"
+
+        def gather_push(self, src_vals, weights):
+            return src_vals + np.uint32(1)
+
+    g = _ring(8)
+    pull, _ = AdaptiveExecutor(g, Skewed(), mode="pull").run(max_iters=2)
+    push, _ = AdaptiveExecutor(g, Skewed(), mode="push").run(max_iters=2)
+    assert not np.array_equal(np.asarray(pull.values),
+                              np.asarray(push.values))
+    apull, _ = AdaptiveExecutor(g, Aligned(), mode="pull").run(max_iters=2)
+    apush, _ = AdaptiveExecutor(g, Aligned(), mode="push").run(max_iters=2)
+    np.testing.assert_array_equal(np.asarray(apull.values),
+                                  np.asarray(apush.values))
+
+
+# -- gascap.v1 artifact ----------------------------------------------------
+
+
+def test_capmap_round_trip(tmp_path):
+    art = gasck.build_capmap({"x": {"derived": {"rooted": True}}},
+                             {"seed": 7})
+    path = str(tmp_path / "gascap.json")
+    gasck.save_capmap(art, path)
+    loaded = gasck.load_capmap(path)
+    assert loaded["id"] == art["id"]
+    assert loaded["programs"] == art["programs"]
+
+
+def test_capmap_id_is_content_addressed_not_timestamped():
+    a = gasck.build_capmap({"x": {"d": 1}}, {"seed": 7})
+    b = gasck.build_capmap({"x": {"d": 1}}, {"seed": 7})
+    c = gasck.build_capmap({"x": {"d": 2}}, {"seed": 7})
+    assert a["id"] == b["id"]       # created_at excluded from the id
+    assert a["id"] != c["id"]
+
+
+def test_capmap_tamper_rejected(tmp_path):
+    art = gasck.build_capmap(
+        {"sssp": {"derived": {"incremental_ok": False}}}, {"seed": 7})
+    path = str(tmp_path / "gascap.json")
+    gasck.save_capmap(art, path)
+    doc = json.loads(open(path).read())
+    doc["programs"]["sssp"]["derived"]["incremental_ok"] = True
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    with pytest.raises(ValueError, match="content hash"):
+        gasck.load_capmap(path)
+
+
+def test_capmap_path_honors_flag(tmp_path):
+    with flags.overrides({"LUX_GASCAP_DIR": str(tmp_path)}):
+        assert gasck.capmap_path() == str(tmp_path / "gascap.json")
+    assert gasck.capmap_path().endswith(
+        os.path.join("analysis", "gascap.json"))
+
+
+# -- capability-derived registry surfaces ---------------------------------
+
+
+def test_models_capabilities_come_from_artifact():
+    import lux_tpu.models as models
+
+    rep = models.capability_report(refresh=True)
+    assert rep["source"] == "artifact"
+    assert rep["error"] is None
+    assert rep["artifact_id"].startswith("gascap-")
+    assert models.rooted_apps() == frozenset({"bfs", "sssp", "sssp_delta"})
+    assert models.incremental_ok("sssp")
+    assert models.incremental_ok("components")
+    assert not models.incremental_ok("bfs")
+    assert models.frontier_ok("labelprop")
+    assert not models.frontier_ok("pagerank")
+
+
+def test_models_fall_back_to_declared_when_artifact_missing(tmp_path):
+    import lux_tpu.models as models
+
+    try:
+        with flags.overrides({"LUX_GASCAP_DIR": str(tmp_path)}):
+            rep = models.capability_report(refresh=True)
+            assert rep["source"] == "declared"
+            assert "artifact missing" in rep["error"]
+            # Declarations carry the same bits, so routing still works.
+            assert models.rooted_apps() == frozenset(
+                {"bfs", "sssp", "sssp_delta"})
+    finally:
+        assert models.capability_report(refresh=True)["source"] == \
+            "artifact"
+
+
+def test_models_reject_tampered_artifact(tmp_path):
+    import lux_tpu.models as models
+
+    art = json.loads(open(gasck.capmap_path()).read())
+    art["programs"]["sssp"]["derived"]["rooted"] = False
+    with open(tmp_path / "gascap.json", "w") as fh:
+        json.dump(art, fh)
+    try:
+        with flags.overrides({"LUX_GASCAP_DIR": str(tmp_path)}):
+            rep = models.capability_report(refresh=True)
+            assert rep["source"] == "declared"
+            assert "artifact rejected" in rep["error"]
+    finally:
+        models.capability_report(refresh=True)
+
+
+# -- the IncrementalExecutor contract gate --------------------------------
+
+
+def test_require_incremental_accepts_proven_programs():
+    gasck.require_incremental(SSSP())
+    gasck.require_incremental(ConnectedComponents())
+
+
+def test_incremental_gate_rejects_programs_without_relax():
+    with pytest.raises(ProgramContractError, match="LUX604") as ei:
+        IncrementalExecutor(_ring(), BFS())
+    assert "relax" in str(ei.value)
+    with pytest.raises(ProgramContractError, match="LUX604"):
+        gasck.require_incremental(DeltaSSSP())
+
+
+def test_incremental_gate_names_failed_subcheck():
+    class Claimant(ConnectedComponents):
+        name = "claimant"
+
+        def relax(self, src_vals, weights):
+            return src_vals + np.uint32(1)
+
+    # relax moves against the max order (messages exceed their source,
+    # so a stale warm start can't be re-reached) -> the gate quotes the
+    # inflationarity sub-check, not a generic refusal.
+    with pytest.raises(ProgramContractError, match="inflationary"):
+        gasck.require_incremental(Claimant())
+
+
+def test_incremental_executor_still_accepts_proven_programs():
+    from lux_tpu.engine.program import as_gas
+
+    g = _ring(16)
+    ref, _ = AdaptiveExecutor(g, as_gas(ConnectedComponents())).run()
+    inc = IncrementalExecutor(g, ConnectedComponents())
+    # No-edit refresh from the converged labels: the gate admits the
+    # proven program and the warm start reproduces the fixpoint bitwise.
+    state, iters, info = inc.run(np.asarray(ref.values))
+    np.testing.assert_array_equal(np.asarray(state.values),
+                                  np.asarray(ref.values))
+
+
+# -- serve-pool audit + session surfaces ----------------------------------
+
+
+def test_pool_audit_is_advisory_and_counted():
+    class BrokenApply(GasProgram):
+        name = "pool_broken_apply"
+        servable = False
+        frontier_ok = False
+
+        def init_values(self, graph, **kw):
+            return np.zeros(graph.nv, dtype=np.uint32)
+
+        def init_frontier(self, graph, **kw):
+            return np.ones(graph.nv, dtype=bool)
+
+        def gather(self, src_vals, weights):
+            return src_vals
+
+        def apply(self, old, acc):
+            return acc
+
+    pool = EnginePool(scope="test-gasck")
+    try:
+        before = pool.stats()["gas_findings"]
+        ex = pool.get(("k1",), lambda: types.SimpleNamespace(
+            program=BrokenApply()))
+        assert ex is not None            # advisory: the build survives
+        after = pool.stats()["gas_findings"]
+        assert after >= before + 1
+        # Cache hit on a second engine for the same program identity.
+        pool.get(("k2",), lambda: types.SimpleNamespace(
+            program=BrokenApply()))
+        assert pool.stats()["gas_findings"] >= after + 1
+    finally:
+        pool.close()
+
+
+def test_pool_audit_clean_program_and_flag_gate():
+    pool = EnginePool(scope="test-gasck-clean")
+    try:
+        before = pool.stats()["gas_findings"]
+        pool.get(("k",), lambda: types.SimpleNamespace(program=SSSP()))
+        assert pool.stats()["gas_findings"] == before
+        with flags.overrides({"LUX_GAS_POOL_AUDIT": "0"}):
+            pool.get(("k3",), lambda: types.SimpleNamespace(
+                program=types.SimpleNamespace(combiner="bogus")))
+        assert pool.stats()["gas_findings"] == before   # gated off
+    finally:
+        pool.close()
+
+
+def test_session_statusz_programs_block():
+    from lux_tpu.obs import metrics
+    from lux_tpu.serve.session import Session
+
+    # The findings counter is process-global by design (dashboards sum
+    # one series); assert the session adds nothing, not absolute zero.
+    before = int(metrics.counter("lux_gas_findings_total").value)
+    src = np.array([0, 1, 2, 3], dtype=np.int64)
+    g = Graph.from_edges(src, (src + 1) % 4, 4)
+    with Session(g, warm=False) as s:
+        blk = s.statusz()["programs"]
+        assert blk["source"] == "artifact"
+        assert blk["artifact_id"].startswith("gascap-")
+        assert "error" not in blk
+        assert blk["capabilities"]["sssp"]["incremental_ok"]
+        assert blk["gas_findings"] == before
+        assert s.statusz()["counters"]["gas_findings"] == before
+        assert s.stats()["programs"]["source"] == "artifact"
+
+
+# -- the --programs CLI ----------------------------------------------------
+
+
+def test_cli_registry_clean():
+    r = _run_cli("--programs")
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary_line(r.stdout)
+    assert s["schema"] == "luxlint-programs.v1"
+    assert s["ok"] and s["findings"] == 0 and s["files"] == 8
+
+
+def test_cli_fixture_exits_one_with_its_rule():
+    r = _run_cli("--programs",
+                 os.path.join(GAS_FIXTURES, "lux603_push_pull_skew.py"))
+    assert r.returncode == 1
+    s = _summary_line(r.stdout)
+    assert s["by_rule"] == {"LUX603": 1}
+    assert "direction-adaptive execution" in r.stdout
+
+
+def test_cli_select_subsets_rules():
+    r = _run_cli("--programs", "--select", "LUX601",
+                 os.path.join(GAS_FIXTURES, "lux602_inexact_sum.py"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary_line(r.stdout)["findings"] == 0
+
+
+def test_cli_gascap_out_writes_artifact(tmp_path):
+    out = str(tmp_path / "gascap.json")
+    r = _run_cli("--programs", "--gascap-out", out)
+    assert r.returncode == 0, r.stdout + r.stderr
+    art = gasck.load_capmap(out)
+    assert art["id"] == gasck.load_capmap(gasck.capmap_path())["id"]
+
+
+def test_cli_baseline_ratchet(tmp_path):
+    base = str(tmp_path / "programs.baseline.json")
+    fix = os.path.join(GAS_FIXTURES, "lux601_bad_identity.py")
+    first = _run_cli("--programs", fix, "--baseline", base)
+    assert first.returncode == 0          # snapshot written, run passes
+    assert os.path.exists(base)
+    second = _run_cli("--programs", fix, "--baseline", base)
+    assert second.returncode == 0         # known finding: ratcheted
+    third = _run_cli("--programs",
+                     os.path.join(GAS_FIXTURES,
+                                  "lux605_clobbering_apply.py"),
+                     "--baseline", base)
+    assert third.returncode == 1          # new finding escapes the ratchet
+    assert "[new]" in third.stdout
+
+
+def test_cli_tiers_are_mutually_exclusive():
+    r = _run_cli("--programs", "--ir")
+    assert r.returncode == 2
+    assert "separate tiers" in r.stderr
+
+
+def test_cli_list_rules_documents_the_tier():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in ("LUX601", "LUX602", "LUX603", "LUX604", "LUX605",
+                 "LUX606"):
+        assert rule in r.stdout

@@ -204,7 +204,8 @@ def test_engine_pool_builds_once():
     st = pool.stats()
     assert st == {"engines": 1, "hits": 1, "misses": 1, "retired": 0,
                   "warmup_compiles": 0, "recompiles": 0,
-                  "ir_findings": 0, "exch_findings": 0}
+                  "ir_findings": 0, "exch_findings": 0,
+                  "gas_findings": 0}
     pool.close()
 
 

@@ -24,6 +24,12 @@ Usage:
     python tools/luxlint.py --tune DIR...    # verify saved tuneconf.v1
                                              #   auto-tuner artifacts
                                              #   (LUX5xx, jax-free)
+    python tools/luxlint.py --programs       # program-contract tier: prove
+                                             #   each registry program's GAS
+                                             #   algebra + derive the
+                                             #   capability matrix (LUX6xx)
+    python tools/luxlint.py --programs f.py  # prove programs defined in
+                                             #   fixture modules instead
     python tools/luxlint.py --baseline F     # snapshot/compare: only findings
                                              #   absent from F fail the run
 
@@ -197,6 +203,27 @@ def _run_tune(paths, select: str):
     return tuneck.verify_artifact_paths(paths, rules)
 
 
+def _run_programs(paths, select: str, gascap_out: str):
+    """Program-contract tier: prove combiner identity/algebra, direction
+    duality, frontier annihilation, and monotone convergence (LUX601-606)
+    per program. Host numpy drives the probes; program hooks run as
+    eager cpu jnp, so no virtual device mesh is needed. With no paths,
+    the registered programs — and a clean run regenerates the gascap.v1
+    capability artifact when --gascap-out names a destination. With
+    paths, fixture modules defining programs (gascap-out is registry-
+    only: fixtures prove rules, they don't define serving capability)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lux_tpu.analysis import gasck
+
+    want = None
+    if select:
+        want = tuple(s.strip() for s in select.split(",") if s.strip())
+    if paths:
+        return gasck.verify_fixture_paths(paths, select=want)
+    return gasck.verify_registry(select=want,
+                                 capmap_out=gascap_out or None)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="luxlint", description=__doc__)
     ap.add_argument("paths", nargs="*",
@@ -231,6 +258,17 @@ def main(argv=None) -> int:
                     help="verify saved tuneconf.v1 auto-tuner artifacts "
                          "(LUX501-504: structure, knob domains, selection "
                          "consistency, staleness; jax-free)")
+    ap.add_argument("--programs", action="store_true",
+                    help="run the program-contract tier (LUX601-606): "
+                         "prove combiner identity/exactness, push/pull "
+                         "duality, frontier annihilation, and monotone "
+                         "convergence for every registered program and "
+                         "derive the gascap.v1 capability matrix; with "
+                         "paths, prove fixture-module programs instead")
+    ap.add_argument("--gascap-out", default="", metavar="FILE",
+                    help="with --programs (registry mode): write the "
+                         "derived gascap.v1 capability artifact here when "
+                         "the run is clean")
     ap.add_argument("--changed", action="store_true",
                     help="AST/threads tiers: restrict to .py files changed "
                          "vs git HEAD (plus untracked); the threads tier "
@@ -242,9 +280,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if sum((args.ir, args.plans, args.threads, args.exchange,
-            args.tune)) > 1:
-        ap.error("--ir, --plans, --threads, --exchange, and --tune are "
-                 "separate tiers; run them separately")
+            args.tune, args.programs)) > 1:
+        ap.error("--ir, --plans, --threads, --exchange, --tune, and "
+                 "--programs are separate tiers; run them separately")
 
     if args.list_rules:
         for r in all_rules():
@@ -268,6 +306,12 @@ def main(argv=None) -> int:
         try:
             from lux_tpu.analysis import tuneck
             for r in tuneck.all_tune_rules():
+                print(f"{r.id}  {r.title}\n       {r.doc}")
+        except Exception:
+            pass
+        try:
+            from lux_tpu.analysis import gasck
+            for r in gasck.all_program_rules():
                 print(f"{r.id}  {r.title}\n       {r.doc}")
         except Exception:
             pass
@@ -307,6 +351,24 @@ def main(argv=None) -> int:
             ap.error("--tune requires at least one artifact file or "
                      "directory")
         report = _run_tune(args.paths, args.select)
+    elif args.programs:
+        if args.changed and not args.paths:
+            # The tier proves live program algebra, not file text: skip
+            # it unless a program-relevant source file changed.
+            relevant = ("lux_tpu/models", "lux_tpu/engine/",
+                        "lux_tpu/analysis/", "lux_tpu/ops/",
+                        "lux_tpu/graph/")
+            changed = [p for p in _changed_paths()
+                       if os.path.relpath(p, _REPO).startswith(relevant)]
+            if not changed:
+                print("luxlint: --changed: no program-relevant files "
+                      "modified")
+                print("LUXLINT " + json.dumps(
+                    {"schema": "luxlint-programs.v1", "files": 0,
+                     "findings": 0, "errors": 0, "ok": True},
+                    sort_keys=True))
+                return 0
+        report = _run_programs(args.paths, args.select, args.gascap_out)
     elif args.threads:
         select = None
         if args.select:

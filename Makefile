@@ -2,7 +2,7 @@
 # library compiles itself on first use into the source-hash cache — the
 # `native` target just runs that one real build path eagerly).
 
-.PHONY: all native lint lint-ir lint-threads lint-exchange lint-programs plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress clean
+.PHONY: all native lint lint-ir lint-threads lint-exchange lint-programs lint-memory mem-smoke plan-check test verify bench bench-gate obs-smoke serve-smoke serve-obs serve-bench serve-slo merge-smoke snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress clean
 
 all: native
 
@@ -36,13 +36,28 @@ lint-exchange:
 lint-programs:
 	env JAX_PLATFORMS=cpu python tools/gasck_smoke.py
 
+# Memory tier: donation-aware buffer-liveness walk over every traced
+# registry target deriving per-device peak live bytes and the closed
+# footprint model f(nv, ne, P, K, exchange_mode), checked against the
+# committed content-addressed memcap.v1 artifact (LUX701-706).
+lint-memory:
+	env JAX_PLATFORMS=cpu python tools/luxlint.py --memory
+
+# Memory-tier acceptance: registry priced clean inside the 2s proof
+# budget, derived memcap.v1 id equal to the committed artifact, the
+# seeded LUX702 donation-leak fixture caught, footprint-LRU pool
+# eviction with zero warm-hit recompiles, and an over-budget engine
+# build shed at the HTTP front end with a typed 503 + Retry-After.
+mem-smoke:
+	env JAX_PLATFORMS=cpu python tools/memck_smoke.py
+
 plan-check:
 	python tools/plan_check.py
 
 test:
 	python -m pytest tests/ -q
 
-verify: lint lint-ir lint-threads lint-exchange lint-programs plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress bench-gate
+verify: lint lint-ir lint-threads lint-exchange lint-programs lint-memory mem-smoke plan-check test serve-obs snapshot-smoke serve-sharded-smoke gas-smoke gas-sharded-smoke exchange-smoke prof-smoke ledger-smoke tune-smoke race-stress chaos-stress bench-gate
 
 bench:
 	python bench.py

@@ -761,4 +761,5 @@ class MultiSourceGasExecutor:
             "donate": (0,),
             "carry": (0,),
             "sharded": False,
+            "k": k,
         }

@@ -1249,5 +1249,6 @@ class ShardedMultiSourceGasExecutor:
             "value_dtype": np.dtype(
                 getattr(self.program, "value_dtype", np.uint32)).name,
             "num_parts": self.num_parts,
+            "k": self.k,
             "plan": self._xplan,
         }

@@ -868,6 +868,7 @@ class MultiSourcePushExecutor:
             "donate": (0,),
             "carry": (0,),
             "sharded": False,
+            "k": k,
         }
 
     def values_for(self, state: PushState, j: int) -> np.ndarray:
@@ -1976,6 +1977,7 @@ class ShardedMultiSourcePushExecutor:
             "value_dtype": np.dtype(
                 getattr(self.program, "value_dtype", np.uint32)).name,
             "num_parts": self.num_parts,
+            "k": self.k,
             "plan": self._xplan,
         }
 

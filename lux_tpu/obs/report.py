@@ -21,21 +21,25 @@ from . import ledger, metrics
 _LOG_ROWS_HEAD = 24
 _LOG_ROWS_TAIL = 8
 
-# Roofline peak-rate registry, keyed on jax's device_kind. HBM rows are
-# the public per-chip HBM bandwidths; ICI rows the per-chip interconnect
-# estimates (v5e: 4 links x ~46.5 GB/s usable). Both are ceilings for
-# *fractions* only. A CPU host has neither HBM nor ICI, so its row
+# Roofline peak-rate registry, keyed on jax's device_kind:
+# (hbm_peak_gbps, ici_peak_gbps, hbm_capacity_bytes). HBM rows are the
+# public per-chip HBM bandwidths; ICI rows the per-chip interconnect
+# estimates (v5e: 4 links x ~46.5 GB/s usable); capacities the public
+# per-chip HBM sizes (v5e 16 GiB, v5p 95 GiB, v4 32 GiB) — the ceiling
+# luxlint --memory's LUX703 budgets against and the serve pool's
+# admission derives its default byte budget from. Rates are ceilings
+# for *fractions* only. A CPU host has neither HBM nor ICI, so its row
 # deliberately prices nothing — and an UNKNOWN kind reports None plus a
 # one-time warning instead of silently assuming v5e (the pre-PR-15
 # behavior priced every chip against the v5e constants).
 _DEVICE_PROFILES = {
-    "TPU v5e": (819.0, 186.0),
-    "TPU v5 lite": (819.0, 186.0),     # v5e's device_kind on some stacks
-    "TPU v5p": (2765.0, 600.0),
-    "TPU v5": (2765.0, 600.0),
-    "TPU v4": (1228.0, 300.0),
-    "cpu": (None, None),
-    "Cpu": (None, None),
+    "TPU v5e": (819.0, 186.0, 16 << 30),
+    "TPU v5 lite": (819.0, 186.0, 16 << 30),  # v5e's kind on some stacks
+    "TPU v5p": (2765.0, 600.0, 95 << 30),
+    "TPU v5": (2765.0, 600.0, 95 << 30),
+    "TPU v4": (1228.0, 300.0, 32 << 30),
+    "cpu": (None, None, None),
+    "Cpu": (None, None, None),
 }
 
 _kind_cache = []
@@ -58,21 +62,26 @@ def _device_kind() -> str:
 def device_profile(kind: str = None) -> dict:
     """The roofline peak-rate row for ``kind`` (default: the live
     backend's device_kind): ``{device_kind, hbm_peak_gbps,
-    ici_peak_gbps, known}``. ``LUX_HBM_PEAK_GBPS`` /
-    ``LUX_ICI_PEAK_GBPS`` override either rate (e.g. a chip the
-    registry predates). An unknown kind without overrides yields None
-    peaks — roofline fractions then stay None rather than pricing
-    against the wrong chip — and warns once per kind."""
+    ici_peak_gbps, hbm_capacity_bytes, known}``. ``LUX_HBM_PEAK_GBPS``
+    / ``LUX_ICI_PEAK_GBPS`` override either rate and
+    ``LUX_HBM_CAPACITY_BYTES`` the capacity (e.g. a chip the registry
+    predates — also the only way cpu runs get a capacity for LUX703).
+    An unknown kind without overrides yields None peaks — roofline
+    fractions then stay None rather than pricing against the wrong
+    chip — and warns once per kind."""
     if kind is None:
         kind = _device_kind()
     row = _DEVICE_PROFILES.get(kind)
-    hbm, ici = row if row else (None, None)
+    hbm, ici, cap = row if row else (None, None, None)
     hbm_env = flags.get("LUX_HBM_PEAK_GBPS")
     ici_env = flags.get("LUX_ICI_PEAK_GBPS")
+    cap_env = flags.get("LUX_HBM_CAPACITY_BYTES")
     if hbm_env:
         hbm = float(hbm_env)
     if ici_env:
         ici = float(ici_env)
+    if cap_env:
+        cap = int(cap_env)
     if row is None and not (hbm_env or ici_env) \
             and kind not in _warned_kinds:
         _warned_kinds.add(kind)
@@ -81,7 +90,8 @@ def device_profile(kind: str = None) -> dict:
             "will be None (set LUX_HBM_PEAK_GBPS/LUX_ICI_PEAK_GBPS to "
             "price this chip)", kind)
     return {"device_kind": kind, "hbm_peak_gbps": hbm,
-            "ici_peak_gbps": ici, "known": row is not None}
+            "ici_peak_gbps": ici, "hbm_capacity_bytes": cap,
+            "known": row is not None}
 
 
 def roofline(summary: dict) -> dict:
@@ -98,6 +108,8 @@ def roofline(summary: dict) -> dict:
     out = {}
     prof_row = device_profile()
     out["device_kind"] = prof_row["device_kind"]
+    if prof_row["hbm_capacity_bytes"]:
+        out["hbm_capacity_bytes"] = prof_row["hbm_capacity_bytes"]
     iters = summary.get("num_iters") or 0
     exec_s = summary.get("execute_s") or 0.0
     hbm = summary.get("hbm_bytes_per_iter")

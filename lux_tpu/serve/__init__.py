@@ -22,6 +22,7 @@ from lux_tpu.serve.errors import (
     BadQueryError,
     CircuitOpenError,
     DeadlineExceededError,
+    PoolOverBudgetError,
     QueueFullError,
     ServeError,
     SnapshotSwapError,
@@ -47,4 +48,5 @@ __all__ = [
     "BadQueryError",
     "SnapshotSwapError",
     "CircuitOpenError",
+    "PoolOverBudgetError",
 ]

@@ -53,6 +53,22 @@ class SnapshotSwapError(ServeError):
     retry_after_s = 2.0
 
 
+class PoolOverBudgetError(ServeError):
+    """The HBM-budgeted engine pool cannot admit this build: its
+    memcap.v1-predicted footprint exceeds the per-device budget
+    (LUX_HBM_BUDGET_BYTES, default device capacity x
+    LUX_HBM_BUDGET_FRAC) even after evicting every cold engine. Shed
+    with 503 + Retry-After — admitting would OOM the device, and the
+    static tier (LUX703) exists so this is reached only by budgets
+    tighter than the bench-scale contract."""
+
+    http_status = 503
+
+    def __init__(self, msg: str, retry_after_s: float = 2.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class CircuitOpenError(ServeError):
     """The circuit breaker for this (program, fingerprint) is open: the
     engine failed ``LUX_BREAKER_THRESHOLD`` consecutive times and is

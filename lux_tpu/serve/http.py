@@ -146,7 +146,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, status: int, payload: dict,
                trace_id: str = None, retry_after: float = None,
-               cost: str = None, tuned: dict = None):
+               cost: str = None, tuned: dict = None,
+               evicted: int = None):
         body = json.dumps(payload).encode()
         # Counted HERE and only here, so every terminal status — success,
         # shed, breaker-open, handler bug — lands in one per-code series
@@ -170,6 +171,11 @@ class _Handler(BaseHTTPRequestHandler):
             # engines were built under (absent on default-config apps),
             # so a client-side A/B can attribute latency to the tuner.
             self.send_header("X-Lux-Tuned", tuned["id"])
+        if evicted:
+            # Swap summaries note HBM-budget pool evictions: warming
+            # N+1 displaced this many cold engines (serve/pool.py
+            # footprint-weighted LRU under LUX_HBM_BUDGET_BYTES).
+            self.send_header("X-Lux-Evicted", str(evicted))
         if self.session is not None:
             self.send_header("X-Lux-Snapshot", str(self.session.version))
             degraded = self.session.degraded
@@ -288,8 +294,9 @@ class _Handler(BaseHTTPRequestHandler):
                                               or body.get("delete")):
                     # Revalidate / coalesce: fold whatever is queued (or
                     # retry an aborted swap) without new edits.
-                    self._reply(200, self.session.flush_edits(),
-                                trace_id=tid)
+                    summary = self.session.flush_edits()
+                    self._reply(200, summary, trace_id=tid,
+                                evicted=summary.get("hbm_evicted"))
                     return
                 try:
                     edits = EdgeEdits.from_lists(
@@ -304,7 +311,8 @@ class _Handler(BaseHTTPRequestHandler):
                     summary = self.session.enqueue_edits(edits)
                 else:
                     summary = self.session.apply_edits(edits)
-                self._reply(200, summary, trace_id=tid)
+                self._reply(200, summary, trace_id=tid,
+                            evicted=summary.get("hbm_evicted"))
             except ServeError as e:
                 self._reply(e.http_status, {
                     "error": str(e), "kind": type(e).__name__,

@@ -13,14 +13,27 @@ Evidence that the contract holds comes at two levels: hit/miss counters
 XLA backend compiles per key — builds run under ``expect(key)``, the
 session executes queries under ``watch(key)``, and any compile landing
 in a watch region is a recompile the stats (and the serve tests) flag.
+
+Residency is HBM-budgeted: callers that know an engine's predicted
+per-device footprint (the memcap.v1 admission formula,
+``analysis/memck.predicted_engine_bytes``) pass it to :meth:`get`, and
+the pool admits the build only if the summed resident bytes fit the
+budget (``LUX_HBM_BUDGET_BYTES``, default device capacity x
+``LUX_HBM_BUDGET_FRAC``), evicting cold engines by footprint-weighted
+LRU first. An engine that cannot fit even in an empty pool is refused
+with :class:`~lux_tpu.serve.errors.PoolOverBudgetError` (HTTP 503 +
+Retry-After) — shedding beats OOMing the device mid-batch. Warm hits
+never evict, so the zero-recompile contract on repeat traffic is
+untouched by the budget.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Hashable
+from typing import Callable, Hashable, Optional
 
 from lux_tpu.analysis.sentinel import RecompileSentinel
 from lux_tpu.obs import metrics, spans
+from lux_tpu.serve.errors import PoolOverBudgetError
 from lux_tpu.utils import faults, flags
 from lux_tpu.utils.locks import make_lock
 
@@ -39,11 +52,26 @@ class EnginePool:
         self._exch_findings = metrics.counter("lux_exch_findings_total")
         self._gas_findings = metrics.counter("lux_gas_findings_total")
         self._retired = metrics.counter("lux_serve_pool_retired_total")
+        # HBM residency accounting: predicted resident bytes per key
+        # (memcap.v1 admission formula) + last-hit clock for the
+        # footprint-weighted LRU.
+        self._resident = {}
+        self._last_hit = {}
+        self._hbm_gauge = metrics.gauge("lux_pool_hbm_resident_bytes")
+        self._hbm_evictions = metrics.counter(
+            "lux_pool_hbm_evictions_total")
         self.sentinel = RecompileSentinel(scope)
 
-    def get(self, key: Hashable, factory: Callable[[], object]):
+    def get(self, key: Hashable, factory: Callable[[], object],
+            footprint_bytes: Optional[int] = None):
         """The executor for ``key``, building (and warming, if the
         executor has a ``warmup``) via ``factory`` on first request.
+
+        ``footprint_bytes`` is the build's predicted per-device resident
+        footprint (memcap.v1); when given, admission runs first —
+        evicting cold engines until the build fits the HBM budget, or
+        raising :class:`PoolOverBudgetError` if it never can. Hits skip
+        admission entirely (and refresh the key's LRU clock).
 
         The build runs under the lock: concurrent first requests for one
         key must not compile twice, and the serving layer funnels engine
@@ -52,7 +80,9 @@ class EnginePool:
             ex = self._engines.get(key)
             if ex is not None:
                 self._hits.inc()
+                self._last_hit[key] = spans.clock()
                 return ex
+            self._admit(key, footprint_bytes)
             self._misses.inc()
             # spans.span (not trace.span): a build triggered by a live
             # request joins that request's trace; warmup builds root
@@ -72,7 +102,58 @@ class EnginePool:
             self._audit_exchange(key, ex)
             self._audit_programs(key, ex)
             self._engines[key] = ex
+            self._last_hit[key] = spans.clock()
+            if footprint_bytes is not None:
+                self._resident[key] = int(footprint_bytes)
+                self._hbm_gauge.set(float(sum(self._resident.values())))
             return ex
+
+    def _admit(self, key: Hashable, footprint_bytes: Optional[int]):
+        """Fit ``footprint_bytes`` under the HBM budget, evicting cold
+        engines by footprint-weighted LRU (idle_seconds x bytes,
+        coldest-and-fattest first). Caller holds the lock. No-op when
+        admission is disabled, unpriced, or unbudgeted — the static
+        tier (LUX703) already proved bench scales fit real devices, so
+        a live budget only engages when configured tighter."""
+        if footprint_bytes is None:
+            return
+        if not flags.get_bool("LUX_MEM_POOL_ADMIT"):
+            return
+        from lux_tpu.analysis import memck
+        budget = memck.hbm_budget_bytes()
+        if budget is None:
+            return
+        need = int(footprint_bytes)
+        if need > budget:
+            raise PoolOverBudgetError(
+                f"engine {key!r} predicted footprint {need} B exceeds "
+                f"the per-device HBM budget {budget} B even with an "
+                "empty pool (LUX_HBM_BUDGET_BYTES / "
+                "LUX_HBM_BUDGET_FRAC)")
+        now = spans.clock()
+        while sum(self._resident.values()) + need > budget:
+            victims = [k for k in self._resident if k in self._engines]
+            if not victims:
+                # Remaining residency belongs to nothing evictable
+                # (stale accounting); drop it rather than deadlock.
+                self._resident = {k: v for k, v in self._resident.items()
+                                  if k in self._engines}
+                if sum(self._resident.values()) + need <= budget:
+                    break
+                raise PoolOverBudgetError(
+                    f"engine {key!r} predicted footprint {need} B does "
+                    f"not fit the HBM budget {budget} B and no resident "
+                    "engine remains to evict")
+            coldest = max(
+                victims,
+                key=lambda k: (now - self._last_hit.get(k, 0.0))
+                * max(1, self._resident[k]))
+            del self._engines[coldest]
+            self._resident.pop(coldest, None)
+            self._last_hit.pop(coldest, None)
+            self._retired.inc()
+            self._hbm_evictions.inc()
+        self._hbm_gauge.set(float(sum(self._resident.values())))
 
     def _audit(self, key: Hashable, ex) -> None:
         """LUX104 donation audit on the freshly built engine: one abstract
@@ -142,8 +223,11 @@ class EnginePool:
             victims = [k for k in self._engines if predicate(k)]
             for k in victims:
                 del self._engines[k]
+                self._resident.pop(k, None)
+                self._last_hit.pop(k, None)
             if victims:
                 self._retired.inc(len(victims))
+                self._hbm_gauge.set(float(sum(self._resident.values())))
         return len(victims)
 
     def __len__(self) -> int:
@@ -156,6 +240,12 @@ class EnginePool:
         with self._lock:
             return list(self._engines)
 
+    def hbm_resident_bytes(self) -> int:
+        """Summed memcap.v1-predicted bytes of the resident engines
+        (only engines admitted with a footprint contribute)."""
+        with self._lock:
+            return int(sum(self._resident.values()))
+
     def stats(self) -> dict:
         return {
             "engines": len(self),
@@ -167,6 +257,8 @@ class EnginePool:
             "ir_findings": int(self._ir_findings.value),
             "exch_findings": int(self._exch_findings.value),
             "gas_findings": int(self._gas_findings.value),
+            "hbm_resident_bytes": self.hbm_resident_bytes(),
+            "hbm_evictions": int(self._hbm_evictions.value),
         }
 
     def close(self):

@@ -227,6 +227,35 @@ class Session:
             snap.fingerprint, snap.graph, self.meshspec.num_parts
         )
 
+    def _footprint(self, kind: str, app: str, snap: Snapshot,
+                   k: int = 1) -> Optional[int]:
+        """Predicted per-device resident bytes for one engine build —
+        the committed memcap.v1 admission formula
+        (analysis/memck.predicted_engine_bytes), resolved under the
+        same exchange mode the engine key carries. None (pool admits
+        freely) when admission is off, the artifact prices nothing for
+        this build, or pricing itself fails — pricing is advisory
+        input to admission, never a reason a build can't start."""
+        if not flags.get_bool("LUX_MEM_POOL_ADMIT"):
+            return None
+        try:
+            from lux_tpu.analysis import memck
+
+            mode = ""
+            rkind = kind + "_sharded" if self.sharded else kind
+            if self.sharded:
+                from lux_tpu.parallel.shard import exchange_mode
+
+                art = self._tuned_art(app, snap)
+                mode = (art or {}).get("config", {}).get("LUX_EXCHANGE") \
+                    or exchange_mode()
+            return memck.predicted_engine_bytes(
+                app, rkind, mode, snap.graph.nv, snap.graph.ne,
+                self.meshspec.num_parts, k=k)
+        # luxlint: disable=LUX007 -- advisory pricing must never block a build
+        except Exception:
+            return None
+
     def _sssp_single(self, snap: Optional[Snapshot] = None):
         from lux_tpu.engine.push import PushExecutor, ShardedPushExecutor
         from lux_tpu.models.sssp import SSSP
@@ -239,11 +268,13 @@ class Session:
                     snap.graph, SSSP(), mesh=self.meshspec.mesh,
                     sg=self._shard_plan(snap),
                 )),
+                footprint_bytes=self._footprint("push", "sssp", snap),
             )
         return self.pool.get(
             self._engine_key("push", snap, ("sssp", 1)),
             self._tuned_build(
                 "sssp", snap, lambda: PushExecutor(snap.graph, SSSP())),
+            footprint_bytes=self._footprint("push", "sssp", snap),
         )
 
     def _sssp_multi(self, snap: Optional[Snapshot] = None):
@@ -261,11 +292,15 @@ class Session:
                         snap.graph, SSSP(), k=k, mesh=self.meshspec.mesh,
                         sg=self._shard_plan(snap),
                     )),
+                footprint_bytes=self._footprint(
+                    "push_multi", "sssp", snap, k=k),
             )
         return self.pool.get(
             self._engine_key("push_multi", snap, ("sssp", k)),
             self._tuned_build("sssp", snap, lambda: MultiSourcePushExecutor(
                 snap.graph, SSSP(), k=k)),
+            footprint_bytes=self._footprint(
+                "push_multi", "sssp", snap, k=k),
         )
 
     def _components_engine(self, snap: Optional[Snapshot] = None):
@@ -281,11 +316,14 @@ class Session:
                         snap.graph, ConnectedComponents(),
                         mesh=self.meshspec.mesh, sg=self._shard_plan(snap),
                     )),
+                footprint_bytes=self._footprint(
+                    "push", "components", snap),
             )
         return self.pool.get(
             self._engine_key("push", snap, ("components", 1)),
             self._tuned_build("components", snap, lambda: PushExecutor(
                 snap.graph, ConnectedComponents())),
+            footprint_bytes=self._footprint("push", "components", snap),
         )
 
     def _pagerank_engine(self, snap: Optional[Snapshot] = None):
@@ -326,6 +364,7 @@ class Session:
         return self.pool.get(
             self._engine_key("pull", snap, ("pagerank",)),
             self._tuned_build("pagerank", snap, build),
+            footprint_bytes=self._footprint("pull", "pagerank", snap),
         )
 
     # -- GAS apps (direction-optimizing adaptive executor) ----------------
@@ -521,6 +560,31 @@ class Session:
             "gas_findings": self.pool.stats()["gas_findings"],
         }
 
+    def _memory_block(self) -> dict:
+        """The /statusz ``memory`` view: the HBM budget admission runs
+        under, the summed memcap.v1-predicted resident bytes, eviction
+        pressure, and where the formula came from (artifact id +
+        device capacity)."""
+        from lux_tpu.analysis import memck
+        from lux_tpu.obs import report
+
+        p = self.pool.stats()
+        art = memck._committed()
+        try:
+            budget = memck.hbm_budget_bytes()
+        # luxlint: disable=LUX007 -- a broken budget derivation must not break /statusz
+        except Exception:
+            budget = None
+        return {
+            "admission": flags.get_bool("LUX_MEM_POOL_ADMIT"),
+            "budget_bytes": budget,
+            "resident_bytes": p["hbm_resident_bytes"],
+            "evictions": p["hbm_evictions"],
+            "artifact_id": (art or {}).get("id"),
+            "hbm_capacity_bytes": report.device_profile()
+            .get("hbm_capacity_bytes"),
+        }
+
     def _tuned_build(self, app: str, snap: Snapshot, build):
         """Wrap an engine builder so every pool miss — warmup, a
         breaker rebuild, the first use of a sibling key — constructs
@@ -556,11 +620,14 @@ class Session:
                     return AdaptiveExecutor(
                         snap.graph, self._gas_program(app, extra))
 
-            return self.pool.get(key, self._tuned_build(app, snap, build))
+            return self.pool.get(
+                key, self._tuned_build(app, snap, build),
+                footprint_bytes=self._footprint("gas", app, snap))
         return self.pool.get(
             key,
             self._tuned_build(app, snap, lambda: AdaptiveExecutor(
                 snap.graph, self._gas_program(app, extra))),
+            footprint_bytes=self._footprint("gas", app, snap),
         )
 
     def _gas_multi(self, app: str, snap: Optional[Snapshot] = None):
@@ -586,11 +653,15 @@ class Session:
                     return MultiSourceGasExecutor(
                         snap.graph, get_program(app), k=k)
 
-            return self.pool.get(key, self._tuned_build(app, snap, build))
+            return self.pool.get(
+                key, self._tuned_build(app, snap, build),
+                footprint_bytes=self._footprint(
+                    "gas_multi", app, snap, k=k))
         return self.pool.get(
             key,
             self._tuned_build(app, snap, lambda: MultiSourceGasExecutor(
                 snap.graph, get_program(app), k=k)),
+            footprint_bytes=self._footprint("gas_multi", app, snap, k=k),
         )
 
     def warmup(self, snap: Optional[Snapshot] = None):
@@ -618,28 +689,36 @@ class Session:
         else:
             self.log.info("program capabilities: %s %s", caps["source"],
                           caps.get("artifact_id"))
+        # An engine the HBM budget refuses must not abort warmup (and
+        # with it server boot): warm what fits, count the skips, and let
+        # queries for the rest shed per-request with the typed 503.
+        def _warm(label, build, *args, **kw):
+            from lux_tpu.serve.errors import PoolOverBudgetError
+
+            with _timed(self.log, f"warmup {label}"):
+                try:
+                    build(*args, **kw)
+                except PoolOverBudgetError as e:
+                    metrics.counter("lux_pool_hbm_warm_skips_total",
+                                    {"engine": label}).inc()
+                    self.log.warning("warmup %s skipped: %s", label, e)
+
         with spans.span("serve.warmup", version=snap.version):
             faults.point("snapshot.warm")
-            with _timed(self.log, "warmup sssp single"):
-                self._sssp_single(snap)
-            with _timed(self.log, "warmup sssp multi"):
-                self._sssp_multi(snap)
-            with _timed(self.log, "warmup components"):
-                self._components_engine(snap)
-            with _timed(self.log, "warmup pagerank"):
-                self._pagerank_engine(snap)
+            _warm("sssp single", self._sssp_single, snap)
+            _warm("sssp multi", self._sssp_multi, snap)
+            _warm("components", self._components_engine, snap)
+            _warm("pagerank", self._pagerank_engine, snap)
             for app in self._gas_rooted:
-                with _timed(self.log, f"warmup {app} gas"):
-                    self._gas_single(app, snap)
-                with _timed(self.log, f"warmup {app} gas multi"):
-                    self._gas_multi(app, snap)
+                _warm(f"{app} gas", self._gas_single, app, snap)
+                _warm(f"{app} gas multi", self._gas_multi, app, snap)
             for app in self._gas_fixpoints:
                 # kcore's default k is baked into the warm engine key so
                 # default-parameter queries hit it; non-default k builds
                 # (and warms) a sibling engine on first use.
                 extra = (2,) if app == "kcore" else ()
-                with _timed(self.log, f"warmup {app} gas"):
-                    self._gas_single(app, snap, extra=extra)
+                _warm(f"{app} gas", self._gas_single, app, snap,
+                      extra=extra)
         # One durable observation per warmed snapshot: what this config
         # paid to get every served engine compiled and resident.
         ledger.record_run(
@@ -1305,6 +1384,7 @@ class Session:
         # Warm version N+1's engines off-thread so a stuck compile can't
         # wedge the session; the sentinel sees the builds as expected
         # warmup (pool.get wraps them in expect(key)).
+        hbm_evictions0 = self.pool.stats()["hbm_evictions"]
         warm_err: List[BaseException] = []
         tid = spans.current_trace_id()
 
@@ -1375,6 +1455,11 @@ class Session:
         metrics.counter("lux_snapshot_applies_total").inc()
 
         drained = self._drain_behind(old)
+        # HBM-budget evictions during this swap's warm: N+1's engines
+        # admitting over N's residents shows up here (and as
+        # X-Lux-Evicted on the HTTP swap response).
+        drained["hbm_evicted"] = (self.pool.stats()["hbm_evictions"]
+                                  - hbm_evictions0)
         swap_s = spans.clock() - t_swap0
         metrics.histogram("lux_snapshot_swap_seconds").observe(swap_s)
         self.log.info(
@@ -1715,6 +1800,7 @@ class Session:
             "mesh": self._mesh_block(),
             "tune": self._tune_block(),
             "programs": self._programs_block(),
+            "memory": self._memory_block(),
             "requests": int(self._requests.value),
         }
         if self._latency.count:
@@ -1755,6 +1841,7 @@ class Session:
             "mesh": self._mesh_block(),
             "tune": self._tune_block(),
             "programs": self._programs_block(),
+            "memory": self._memory_block(),
             # Latest adaptive-executor direction split (push/pull iters,
             # mid-run switches) per GAS engine kind; {} until one runs.
             "gas": {kind: rec for kind, rec in engobs.latest().items()

@@ -89,7 +89,19 @@ def tune(graph, program, engine_kind: str, *,
     cap = max(2, flags.get_int("LUX_TUNE_MAX_CANDIDATES"))
 
     if candidates is None:
-        candidates = space.knob_space(engine_kind)
+        # Footprint-pruned: candidates the memcap.v1 admission formula
+        # would refuse at serving time never burn probe wall-clock.
+        parts = 1
+        try:
+            # mesh_shape is the "x"-joined mesh label ("8", "2x4").
+            for dim in str(mesh_shape).split("x"):
+                parts *= max(1, int(dim))
+        except (TypeError, ValueError):
+            parts = 1
+        candidates = space.knob_space(
+            engine_kind, program_name=program_name,
+            nv=int(getattr(graph, "nv", 0) or 0),
+            ne=int(getattr(graph, "ne", 0) or 0), parts=parts)
     candidates = _subsample(candidates, cap, seed)
 
     t0 = time.perf_counter()

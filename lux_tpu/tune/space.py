@@ -25,7 +25,7 @@ separately and bench.py compares the tuned rows across kinds.
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from lux_tpu.utils import flags
 
@@ -114,10 +114,19 @@ def default_candidate(engine_kind: str) -> Dict[str, str]:
     return {flag: values[0] for flag, values in _axes(engine_kind)}
 
 
-def knob_space(engine_kind: str) -> List[Dict[str, str]]:
+def knob_space(engine_kind: str, *, program_name: Optional[str] = None,
+               nv: Optional[int] = None, ne: Optional[int] = None,
+               parts: int = 1, k: int = 1) -> List[Dict[str, str]]:
     """Deterministic candidate list for one engine kind. Candidate 0 is
     :func:`default_candidate`; kinds with no applicable knobs get just
-    that one (the tuner then records an honest "nothing to tune")."""
+    that one (the tuner then records an honest "nothing to tune").
+
+    When the caller supplies the probe context (``program_name`` +
+    graph dims), candidates whose memcap.v1-predicted footprint does
+    not fit the HBM budget are pruned *before* probing — a candidate
+    that would be refused admission at serving time is wasted probe
+    wall-clock. Candidate 0 is never pruned (the default config is the
+    comparison baseline and the honest fallback)."""
     axes = _axes(engine_kind)
     if not axes:
         return [{}]
@@ -127,4 +136,30 @@ def knob_space(engine_kind: str) -> List[Dict[str, str]]:
         cand = dict(zip(names, combo))
         if _admissible(cand) and cand not in out:
             out.append(cand)
+    if program_name and nv and ne:
+        out = [out[0]] + [c for c in out[1:]
+                          if _fits_budget(c, engine_kind, program_name,
+                                          nv, ne, parts, k)]
     return out
+
+
+def _fits_budget(cand: Dict[str, str], engine_kind: str,
+                 program_name: str, nv: int, ne: int,
+                 parts: int, k: int) -> bool:
+    """True unless the candidate's predicted per-device footprint
+    (under its own LUX_EXCHANGE mode) provably exceeds the HBM budget.
+    Unknown footprint or no budget means fits — pruning only ever removes
+    candidates admission would certainly refuse."""
+    try:
+        from lux_tpu.analysis import memck
+
+        budget = memck.hbm_budget_bytes()
+        if budget is None:
+            return True
+        mode = cand.get("LUX_EXCHANGE", "")
+        pred = memck.predicted_engine_bytes(
+            program_name, engine_kind, mode, nv, ne, parts, k=k)
+        return pred is None or pred <= budget
+    # luxlint: disable=LUX007 -- advisory pruning: a broken predictor keeps the full space
+    except Exception:
+        return True

@@ -371,6 +371,43 @@ define("LUX_GASCK_NV", 24,
        "luxlint --programs: vertex count of the seeded probe graphs the "
        "LUX603 push/pull duality traces run on", kind="int")
 
+# Static analysis, memory tier (analysis/memck.py) and the HBM-budgeted
+# pool residency it feeds (serve/pool.py, tune/space.py, obs/report.py)
+define("LUX_MEMCAP_DIR", None,
+       "directory holding the memcap.v1 HBM-footprint artifact "
+       "(analysis/memck.py) the serving admission formula consults; "
+       "unset = the committed lux_tpu/analysis/memcap.json", kind="path")
+define("LUX_MEM_MODEL_TOL", 0.25,
+       "luxlint --memory LUX704/706: max relative slack between the "
+       "closed-form footprint model and a traced peak (the model must "
+       "upper-bound the trace and stay within this fraction of it)",
+       kind="float")
+define("LUX_MEM_SWEEP_FACTOR", 2,
+       "luxlint --memory LUX704: probe-graph scale multiplier for the "
+       "model-honesty sweep (the model derived at the base scale must "
+       "bound a re-trace at factor x the base)", kind="int")
+define("LUX_MEM_POOL_ADMIT", True,
+       "gate new serve-pool engine builds on the memcap.v1 predicted "
+       "footprint fitting the HBM budget (0 = admit freely; admission "
+       "is also skipped when no budget can be derived)", kind="bool")
+define("LUX_HBM_BUDGET_BYTES", 0,
+       "per-device HBM byte budget the serve pool admits engine builds "
+       "under; 0 = device-profile hbm_capacity_bytes x "
+       "LUX_HBM_BUDGET_FRAC (no budget at all when capacity is unknown, "
+       "e.g. cpu)", kind="int")
+define("LUX_HBM_BUDGET_FRAC", 0.85,
+       "fraction of the device-profile HBM capacity the serve pool may "
+       "fill with resident engines when LUX_HBM_BUDGET_BYTES is 0 (the "
+       "remainder is headroom for XLA scratch and staging)", kind="float")
+define("LUX_HBM_CAPACITY_BYTES", None,
+       "override the device-profile HBM capacity in bytes when the "
+       "registry (obs/report.py) has no row for this device_kind — also "
+       "the only way cpu runs get a LUX703 capacity to check against")
+define("LUX_RESULT_CACHE_BYTES", 64 << 20,
+       "serve ResultCache byte budget: LRU entries evict once their "
+       "summed value nbytes exceed this (the entry-count capacity still "
+       "bounds the dict)", kind="int")
+
 # Concurrency discipline (utils/locks.py, tools/race_stress.py)
 define("LUX_LOCKWATCH", False,
        "wrap every utils/locks.make_lock in the LockWatch sentinel: "

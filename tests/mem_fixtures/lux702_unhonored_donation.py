@@ -1,0 +1,32 @@
+"""Seeded LUX702 violation: the trace spec declares the carry donated,
+but the jit wasn't built with ``donate_argnums`` — the lowered HLO
+carries no input/output aliasing, so both copies of the carry stay
+live and the declared donation buys nothing. LUX104 would call this
+"audited"; the memory tier prices it into the peak.
+
+Loaded by ``tools/luxlint.py --memory <this file>``; the CLI must exit
+1 with exactly LUX702.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(vals, deg):
+    return jnp.minimum(vals, vals[::-1] + deg)
+
+
+# expect: LUX702 -- donation declared below, never lowered into the jit
+_jstep = jax.jit(_step)
+
+TARGETS = {
+    "fixture@lux702": {
+        "fn": _jstep,
+        "args": (jnp.zeros(64, jnp.float32), jnp.ones(64, jnp.float32)),
+        "donate": (0,),
+        "carry": (0,),
+        "sharded": False,
+        "nv": 64,
+        "ne": 64,
+    },
+}

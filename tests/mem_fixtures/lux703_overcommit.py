@@ -1,0 +1,29 @@
+"""Seeded LUX703 violation: an honest step over 4096-vertex float32
+state (~16 KiB live) against a declared device capacity of 1 KiB. The
+derived model predicts a peak that cannot fit, and the budget rule
+fails closed here — offline — instead of OOMing on-device.
+
+Loaded by ``tools/luxlint.py --memory <this file>``; the CLI must exit
+1 with exactly LUX703.
+"""
+
+import jax.numpy as jnp
+
+
+def _step(vals):
+    return jnp.minimum(vals, vals[::-1])
+
+
+# expect: LUX703
+CAPACITY_BYTES = 1024
+
+TARGETS = {
+    "fixture@lux703": {
+        "call": _step,
+        "args": (jnp.zeros(4096, jnp.float32),),
+        "carry": (0,),
+        "sharded": False,
+        "nv": 4096,
+        "ne": 4096,
+    },
+}

@@ -1,0 +1,36 @@
+"""Seeded LUX704 violation: a claimed closed-form footprint model that
+prices the whole engine at one byte. The traced peak is ~KiBs, so the
+formula serving would trust under-estimates the footprint — admission
+would over-pack the device and the OOM arrives at runtime instead of
+in verify.
+
+Loaded by ``tools/luxlint.py --memory <this file>``; the CLI must exit
+1 with exactly LUX704.
+"""
+
+import jax.numpy as jnp
+
+
+def _step(vals, deg):
+    return jnp.minimum(vals, vals[::-1] + deg)
+
+
+TARGETS = {
+    "fixture@lux704": {
+        "call": _step,
+        "args": (jnp.zeros(256, jnp.float32), jnp.ones(256, jnp.float32)),
+        "carry": (0,),
+        "sharded": False,
+        "nv": 256,
+        "ne": 256,
+    },
+}
+
+# expect: LUX704 -- one byte covers nothing
+MODELS = {
+    "fixture@lux704": {
+        "per_vertex_bytes": 0.0,
+        "per_edge_bytes": 0.0,
+        "fixed_bytes": 1,
+    },
+}

@@ -1,0 +1,35 @@
+"""Seeded LUX705 violation: a full-exchange step whose traced
+all-gather stages real buffers, but whose ``exchange_bytes`` claim
+(the figure ``exchange_bytes_per_iter()`` would report to serving and
+the exchange gate) matches none of the collectives actually lowered.
+The peak the walk prices and the claim observability reports have
+diverged — one of them is lying.
+
+Loaded by ``tools/luxlint.py --memory <this file>``; the CLI must exit
+1 with exactly LUX705.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(vals):
+    got = jax.lax.all_gather(vals, "p")
+    return jnp.min(got, axis=0)
+
+
+TARGETS = {
+    "fixture@lux705": {
+        "call": _step,
+        "args": (jnp.zeros(16, jnp.float32),),
+        "carry": (0,),
+        "sharded": False,
+        "axis_env": (("p", 8),),
+        "exchange_mode": "full",
+        # expect: LUX705 -- the traced all-gather moves 8*16*4 bytes/part
+        "exchange_bytes": 12345,
+        "num_parts": 8,
+        "nv": 16,
+        "ne": 16,
+    },
+}

@@ -1,0 +1,42 @@
+"""Seeded LUX706 violation: the committed memcap.v1 stand-in carries
+an admission formula calibrated against some long-gone build — it
+predicts one byte where a fresh trace peaks at ~KiBs. Serving would
+admit engines against the stale footprint; the drift rule demands the
+artifact be regenerated instead.
+
+Loaded by ``tools/luxlint.py --memory <this file>``; the CLI must exit
+1 with exactly LUX706.
+"""
+
+import jax.numpy as jnp
+
+
+def _step(vals, deg):
+    return jnp.minimum(vals, vals[::-1] + deg)
+
+
+TARGETS = {
+    "fixture@lux706": {
+        "call": _step,
+        "args": (jnp.zeros(256, jnp.float32), jnp.ones(256, jnp.float32)),
+        "carry": (0,),
+        "sharded": False,
+        "nv": 256,
+        "ne": 256,
+    },
+}
+
+# expect: LUX706 -- a formula from a build that no longer exists
+COMMITTED = {
+    "schema": "memcap.v1",
+    "targets": {
+        "fixture@lux706": {
+            "k": 1,
+            "model": {
+                "per_vertex_bytes": 0.0,
+                "per_edge_bytes": 0.0,
+                "fixed_bytes": 1,
+            },
+        },
+    },
+}

@@ -1,0 +1,451 @@
+"""luxlint memory tier: the LUX701-706 prover (memck), the memcap.v1
+footprint artifact, the HBM-budgeted EnginePool admission it feeds,
+the tuner's footprint pruning, and the --memory CLI.
+
+Seeded-violation convention (tests/mem_fixtures/): each ``lux7NN_*.py``
+module seeds one broken contract and must make ``luxlint --memory``
+exit 1 with exactly its own rule firing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from lux_tpu.analysis import memck  # noqa: E402
+from lux_tpu.graph.graph import Graph  # noqa: E402
+from lux_tpu.serve.errors import PoolOverBudgetError  # noqa: E402
+from lux_tpu.serve.pool import EnginePool  # noqa: E402
+from lux_tpu.tune import space  # noqa: E402
+from lux_tpu.utils import flags  # noqa: E402
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS)
+LUXLINT = os.path.join(REPO, "tools", "luxlint.py")
+MEM_FIXTURES = os.path.join(TESTS, "mem_fixtures")
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, LUXLINT, *argv],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def _summary_line(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("LUXLINT ")]
+    assert lines, stdout
+    return json.loads(lines[-1][len("LUXLINT "):])
+
+
+def _rules(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# -- liveness walk + attribution units ------------------------------------
+
+
+def test_walk_scope_frees_intermediates_at_last_use():
+    def chain(x):
+        a = x * 2.0          # dies after b
+        b = a + 1.0          # dies after c
+        c = b * 3.0
+        return c
+
+    closed = jax.make_jaxpr(chain)(np.zeros(1024, np.float32))
+    peak, snap, inputs = memck._walk_scope(closed.jaxpr, 1.0)
+    # Input pinned + at most two coexisting intermediates: had nothing
+    # freed, the chain would peak at input + 3 temporaries.
+    assert inputs == 4096
+    assert peak <= 3 * 4096
+    assert peak >= 2 * 4096
+
+
+def test_walk_scope_pins_inputs_and_outputs():
+    def keep(x, y):
+        return x + y, x
+
+    closed = jax.make_jaxpr(keep)(np.zeros(256, np.float32),
+                                  np.zeros(256, np.float32))
+    peak, _, inputs = memck._walk_scope(closed.jaxpr, 1.0)
+    assert inputs == 2 * 1024
+    assert peak >= 3 * 1024      # both inputs + the sum, all pinned
+
+
+def test_classify_attributes_by_probe_unit():
+    assert memck._classify(96.0, 96, 400) == "vertex"
+    assert memck._classify(400.0, 96, 400) == "edge"
+    assert memck._classify(800.0, 96, 400) == "edge"
+    assert memck._classify(7.0, 96, 400) == "fixed"
+
+
+def test_eval_model_scales_lanes_and_parts():
+    model = {"per_vertex_bytes": 4.0, "per_edge_bytes": 2.0,
+             "fixed_bytes": 100}
+    base = memck.eval_model(model, 96, 400, 1)
+    assert base == 4.0 * 96 + 2.0 * 400 + 100
+    # P divides the linear terms (ceil'd), never the constant.
+    sharded = memck.eval_model(model, 96, 400, 8)
+    assert sharded == 4.0 * 12 + 2.0 * 50 + 100
+    # K lanes scale the vertex-proportional state.
+    wide = memck.eval_model(model, 96, 400, 1, k=4, k_probe=2)
+    assert wide == 4.0 * 2 * 96 + 2.0 * 400 + 100
+
+
+def test_model_honesty_floor_tolerates_toy_scale_padding():
+    model = {"per_vertex_bytes": 4.0, "per_edge_bytes": 0.0,
+             "fixed_bytes": 0}
+    # 2x over at toy scale (absolute slack ~KiB): quantisation noise.
+    assert memck._check_model_honesty("t", model, 4.0 * 96 / 2,
+                                      96, 0, 1) == []
+    # Under-estimation never gets a floor.
+    under = memck._check_model_honesty("t", model, 4.0 * 96 * 2, 96, 0, 1)
+    assert [f.rule for f in under] == ["LUX704"]
+
+
+def test_donation_report_prices_unhonored_alias():
+    args = (np.zeros(64, np.float32), np.ones(64, np.float32))
+
+    def step(vals, deg):
+        return vals + deg
+
+    from lux_tpu.analysis import ir
+    honored = ir.target_from_spec("t", {
+        "fn": jax.jit(step, donate_argnums=0), "args": args,
+        "donate": (0,), "carry": (0,)})
+    rep = memck._donation_report(honored)
+    assert rep["checked"] and rep["leak_bytes"] == 0
+
+    flipped = ir.target_from_spec("t", {
+        "fn": jax.jit(step), "args": args,
+        "donate": (0,), "carry": (0,)})
+    rep = memck._donation_report(flipped)
+    assert rep["checked"]
+    assert rep["leak_bytes"] == 64 * 4
+
+
+# -- registry proof + committed artifact ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def registry():
+    """One registry prove shared by the assertions (trace + lowering of
+    every registry target: the expensive part, staged once)."""
+    return memck.prove_registry()
+
+
+def test_registry_proves_clean(registry):
+    report, art = registry
+    assert report.ok, [f.format() for r in report.results
+                       for f in r.findings]
+    assert report.schema == "luxlint-memory.v1"
+    assert not any(r.error for r in report.results)
+    assert len(art["targets"]) >= 30
+
+
+def test_registry_matches_committed_artifact(registry):
+    """The LUX706 offline ratchet: a footprint-changing edit must
+    regenerate lux_tpu/analysis/memcap.json or verify fails."""
+    _, art = registry
+    committed = memck.load_memcap(memck.memcap_path())
+    assert committed["id"] == art["id"]
+
+
+def test_registry_models_bound_their_own_probe(registry):
+    _, art = registry
+    for name, entry in art["targets"].items():
+        pred = memck.eval_model(entry["model"], entry["probe"]["nv"],
+                                entry["probe"]["ne"], entry["parts"],
+                                k=entry["k"], k_probe=entry["k"])
+        assert pred + 1e-6 >= entry["peak_bytes"], name
+
+
+def test_registry_covers_exchange_mode_variants(registry):
+    _, art = registry
+    names = set(art["targets"])
+    assert "sssp@push" in names
+    assert "sssp@push_sharded" in names
+    assert "sssp@push_sharded+compact" in names
+    assert any(n.endswith("+frontier") for n in names)
+    # Sharded entries price their staging.
+    assert art["targets"]["sssp@push_sharded"]["staging_bytes"] > 0
+
+
+# -- seeded fixtures: each fails with exactly its rule --------------------
+
+
+@pytest.mark.parametrize("stem,rule", [
+    ("lux701_malformed_artifact", "LUX701"),
+    ("lux702_unhonored_donation", "LUX702"),
+    ("lux703_overcommit", "LUX703"),
+    ("lux704_dishonest_model", "LUX704"),
+    ("lux705_divergent_exchange_claim", "LUX705"),
+    ("lux706_stale_committed", "LUX706"),
+])
+def test_fixture_fails_with_exactly_its_rule(stem, rule):
+    path = os.path.join(MEM_FIXTURES, stem + ".py")
+    report = memck.verify_fixture_paths([path])
+    assert not report.ok
+    assert _rules(report) == [rule]
+    assert not any(r.error for r in report.results)
+
+
+def test_fixture_select_filters_rules():
+    path = os.path.join(MEM_FIXTURES, "lux704_dishonest_model.py")
+    report = memck.verify_fixture_paths([path], select=("LUX701",))
+    assert report.ok    # the LUX704 finding is filtered out
+
+
+# -- memcap.v1 artifact ----------------------------------------------------
+
+
+def test_memcap_round_trip(tmp_path):
+    art = memck.build_memcap(
+        {"x@push": {"model": {"per_vertex_bytes": 4.0,
+                              "per_edge_bytes": 0.0, "fixed_bytes": 8},
+                    "peak_bytes": 392, "probe": {"nv": 96, "ne": 400}}},
+        {"seed": 7})
+    path = str(tmp_path / "memcap.json")
+    memck.save_memcap(art, path)
+    loaded = memck.load_memcap(path)
+    assert loaded["id"] == art["id"]
+    assert loaded["targets"] == art["targets"]
+
+
+def test_memcap_id_is_content_addressed_not_timestamped():
+    a = memck.build_memcap({"x": {"d": 1}}, {"seed": 7})
+    b = memck.build_memcap({"x": {"d": 1}}, {"seed": 7})
+    c = memck.build_memcap({"x": {"d": 2}}, {"seed": 7})
+    assert a["id"] == b["id"]       # created_at excluded from the id
+    assert a["id"] != c["id"]
+
+
+def test_memcap_tamper_rejected(tmp_path):
+    art = memck.build_memcap(
+        {"x@push": {"peak_bytes": 100}}, {"seed": 7})
+    path = str(tmp_path / "memcap.json")
+    memck.save_memcap(art, path)
+    doc = json.loads(open(path).read())
+    doc["targets"]["x@push"]["peak_bytes"] = 1   # hand-shrunk footprint
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    with pytest.raises(ValueError, match="content hash"):
+        memck.load_memcap(path)
+
+
+def test_memcap_path_honors_flag(tmp_path):
+    with flags.overrides({"LUX_MEMCAP_DIR": str(tmp_path)}):
+        assert memck.memcap_path() == str(tmp_path / "memcap.json")
+    assert memck.memcap_path().endswith(
+        os.path.join("analysis", "memcap.json"))
+
+
+# -- the serving admission formula ----------------------------------------
+
+
+def test_predicted_engine_bytes_from_committed_artifact():
+    art = memck.load_memcap(memck.memcap_path())
+    pred = memck.predicted_engine_bytes("sssp", "push", "", 96, 400, 1,
+                                        art=art)
+    assert pred is not None
+    assert pred >= art["targets"]["sssp@push"]["peak_bytes"]
+    # Exchange-mode variants resolve to their own entry.
+    compact = memck.predicted_engine_bytes("sssp", "push_sharded",
+                                           "compact", 96, 400, 8, art=art)
+    assert compact is not None and compact > 0
+    # Unknown app under a known kind: costliest same-kind entry.
+    assert memck.predicted_engine_bytes("nope", "push", "", 96, 400, 1,
+                                        art=art) is not None
+    # Unknown kind prices nothing — admission runs open, not wrong.
+    assert memck.predicted_engine_bytes("sssp", "bogus", "", 96, 400, 1,
+                                        art=art) is None
+
+
+def test_hbm_budget_flag_overrides_capacity():
+    with flags.overrides({"LUX_HBM_BUDGET_BYTES": "12345"}):
+        assert memck.hbm_budget_bytes() == 12345
+    with flags.overrides({"LUX_HBM_CAPACITY_BYTES": str(1 << 30),
+                          "LUX_HBM_BUDGET_FRAC": "0.5"}):
+        assert memck.hbm_budget_bytes() == (1 << 29)
+
+
+# -- HBM-budgeted pool admission ------------------------------------------
+
+
+def test_pool_evicts_cold_engine_by_footprint_and_keeps_warm_hits():
+    pool = EnginePool(scope="test-memck")
+    try:
+        with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1000"}):
+            ev0 = pool.stats()["hbm_evictions"]
+            rc0 = pool.stats()["recompiles"]
+            a = pool.get(("a",), lambda: types.SimpleNamespace(),
+                         footprint_bytes=600)
+            # Warm hit: no admission, no eviction, no rebuild.
+            assert pool.get(("a",), lambda: types.SimpleNamespace(),
+                            footprint_bytes=600) is a
+            assert pool.stats()["hbm_evictions"] == ev0
+            assert pool.hbm_resident_bytes() == 600
+            # Second engine does not fit: the cold one is evicted.
+            pool.get(("b",), lambda: types.SimpleNamespace(),
+                     footprint_bytes=600)
+            assert pool.stats()["hbm_evictions"] == ev0 + 1
+            assert pool.hbm_resident_bytes() == 600
+            assert pool.keys() == [("b",)]
+            assert pool.stats()["recompiles"] == rc0
+    finally:
+        pool.close()
+
+
+def test_pool_refuses_engine_larger_than_budget():
+    pool = EnginePool(scope="test-memck-refuse")
+    try:
+        with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1000"}):
+            with pytest.raises(PoolOverBudgetError) as ei:
+                pool.get(("fat",), lambda: types.SimpleNamespace(),
+                         footprint_bytes=2000)
+            assert ei.value.http_status == 503
+            assert ei.value.retry_after_s > 0
+        assert len(pool) == 0
+    finally:
+        pool.close()
+
+
+def test_pool_admission_gate_and_unpriced_builds():
+    pool = EnginePool(scope="test-memck-gate")
+    try:
+        with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1000",
+                              "LUX_MEM_POOL_ADMIT": "0"}):
+            pool.get(("fat",), lambda: types.SimpleNamespace(),
+                     footprint_bytes=2000)    # gated off: admitted
+        with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1000"}):
+            # Unpriced builds admit freely (no formula, no refusal).
+            pool.get(("unpriced",), lambda: types.SimpleNamespace())
+        assert len(pool) == 2
+    finally:
+        pool.close()
+
+
+def test_pool_retire_releases_residency():
+    pool = EnginePool(scope="test-memck-retire")
+    try:
+        with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1000"}):
+            pool.get(("a", "f1"), lambda: types.SimpleNamespace(),
+                     footprint_bytes=400)
+            pool.get(("b", "f2"), lambda: types.SimpleNamespace(),
+                     footprint_bytes=400)
+            assert pool.hbm_resident_bytes() == 800
+            pool.retire(lambda k: k[1] == "f1")
+            assert pool.hbm_resident_bytes() == 400
+    finally:
+        pool.close()
+
+
+def test_session_statusz_memory_block():
+    from lux_tpu.obs import metrics
+    from lux_tpu.serve.session import Session
+
+    # The eviction counter is process-global by design (dashboards sum
+    # one series); assert the session adds nothing, not absolute zero.
+    before = int(metrics.counter("lux_pool_hbm_evictions_total").value)
+    src = np.array([0, 1, 2, 3], dtype=np.int64)
+    g = Graph.from_edges(src, (src + 1) % 4, 4)
+    with Session(g, warm=False) as s:
+        blk = s.statusz()["memory"]
+        assert blk["admission"] is True
+        assert blk["artifact_id"].startswith("memcap-")
+        assert blk["resident_bytes"] == 0
+        assert blk["evictions"] == before
+        # CPU profile exposes no HBM: budget runs open by default.
+        assert blk["budget_bytes"] is None
+        assert s.stats()["memory"]["artifact_id"] == blk["artifact_id"]
+
+
+# -- tuner footprint pruning ----------------------------------------------
+
+
+def test_knob_space_prunes_unaffordable_candidates():
+    full = space.knob_space("push_sharded")
+    assert len(full) > 1
+    # No budget (CPU profile): the probe context changes nothing.
+    assert space.knob_space("push_sharded", program_name="sssp",
+                            nv=4096, ne=16384, parts=8) == full
+    with flags.overrides({"LUX_HBM_BUDGET_BYTES": "1"}):
+        pruned = space.knob_space("push_sharded", program_name="sssp",
+                                  nv=4096, ne=16384, parts=8)
+    # Candidate 0 (all defaults) survives any budget; the rest cannot
+    # fit one byte.
+    assert pruned == [full[0]]
+
+
+# -- the --memory CLI ------------------------------------------------------
+
+
+def test_cli_memcap_out_reproduces_committed_artifact(tmp_path):
+    out = str(tmp_path / "memcap.json")
+    r = _run_cli("--memory", "--memcap-out", out)
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = _summary_line(r.stdout)
+    assert s["schema"] == "luxlint-memory.v1"
+    assert s["ok"] and s["findings"] == 0
+    art = memck.load_memcap(out)
+    assert art["id"] == memck.load_memcap(memck.memcap_path())["id"]
+
+
+def test_cli_fixture_exits_one_with_its_rule():
+    r = _run_cli("--memory",
+                 os.path.join(MEM_FIXTURES, "lux703_overcommit.py"))
+    assert r.returncode == 1
+    s = _summary_line(r.stdout)
+    assert s["by_rule"] == {"LUX703": 1}
+    assert "HBM capacity" in r.stdout
+
+
+def test_cli_select_subsets_rules():
+    r = _run_cli("--memory", "--select", "LUX701",
+                 os.path.join(MEM_FIXTURES, "lux704_dishonest_model.py"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _summary_line(r.stdout)["findings"] == 0
+
+
+def test_cli_baseline_ratchet(tmp_path):
+    base = str(tmp_path / "memory.baseline.json")
+    fix = os.path.join(MEM_FIXTURES, "lux704_dishonest_model.py")
+    first = _run_cli("--memory", fix, "--baseline", base)
+    assert first.returncode == 0          # snapshot written, run passes
+    assert os.path.exists(base)
+    second = _run_cli("--memory", fix, "--baseline", base)
+    assert second.returncode == 0         # known finding: ratcheted
+    third = _run_cli("--memory",
+                     os.path.join(MEM_FIXTURES, "lux703_overcommit.py"),
+                     "--baseline", base)
+    assert third.returncode == 1          # new finding escapes the ratchet
+    assert "[new]" in third.stdout
+
+
+def test_cli_changed_contract():
+    # Content depends on git state; the contract is: it runs (or early-
+    # exits when no footprint-relevant file changed) and still emits
+    # the greppable summary line with this tier's schema.
+    r = _run_cli("--memory", "--changed")
+    assert r.returncode in (0, 1), r.stdout + r.stderr
+    assert _summary_line(r.stdout)["schema"] == "luxlint-memory.v1"
+
+
+def test_cli_tiers_are_mutually_exclusive():
+    r = _run_cli("--memory", "--ir")
+    assert r.returncode == 2
+    assert "separate tiers" in r.stderr
+
+
+def test_cli_list_rules_documents_the_tier():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in ("LUX701", "LUX702", "LUX703", "LUX704", "LUX705",
+                 "LUX706"):
+        assert rule in r.stdout

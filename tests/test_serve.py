@@ -205,7 +205,8 @@ def test_engine_pool_builds_once():
     assert st == {"engines": 1, "hits": 1, "misses": 1, "retired": 0,
                   "warmup_compiles": 0, "recompiles": 0,
                   "ir_findings": 0, "exch_findings": 0,
-                  "gas_findings": 0}
+                  "gas_findings": 0, "hbm_resident_bytes": 0,
+                  "hbm_evictions": 0}
     pool.close()
 
 
@@ -220,6 +221,35 @@ def test_result_cache_lru_evicts_oldest():
     assert c.get("a") == 1 and c.get("c") == 3
     st = c.stats()
     assert st["evictions"] == 1 and st["size"] == 2
+
+
+def test_result_cache_evicts_by_value_bytes():
+    metrics.reset()
+    c = ResultCache(capacity=256, capacity_bytes=10_000)
+    c.put("a", np.zeros(1024, np.float32))       # 4096 B
+    c.put("b", np.zeros(1024, np.float32))
+    assert c.get("a") is not None                # refresh a
+    c.put("c", np.zeros(1024, np.float32))       # 12 KiB > budget: b goes
+    assert c.get("b") is None
+    assert c.get("a") is not None and c.get("c") is not None
+    st = c.stats()
+    assert st["size"] == 2 and st["bytes"] == 8192
+    assert st["capacity_bytes"] == 10_000 and st["evictions"] == 1
+    # Tree-valued entries price their array leaves.
+    c.put("d", {"values": np.zeros(512, np.float32), "iters": 3})
+    assert c.stats()["bytes"] >= 8192 - 4096 + 2048
+
+
+def test_result_cache_oversized_entry_occupies_whole_budget():
+    metrics.reset()
+    c = ResultCache(capacity=4, capacity_bytes=1000)
+    c.put("small", np.zeros(8, np.float32))
+    c.put("huge", np.zeros(4096, np.float32))    # over budget by itself
+    assert c.get("huge") is not None             # newest never self-evicts
+    assert c.get("small") is None
+    c.put("next", np.zeros(8, np.float32))       # displaces the whale
+    assert c.get("huge") is None
+    assert c.get("next") is not None
 
 
 # -- session routing ------------------------------------------------------

@@ -30,6 +30,12 @@ Usage:
                                              #   capability matrix (LUX6xx)
     python tools/luxlint.py --programs f.py  # prove programs defined in
                                              #   fixture modules instead
+    python tools/luxlint.py --memory         # memory tier: donation-aware
+                                             #   HBM-footprint walk over every
+                                             #   traced registry target +
+                                             #   memcap.v1 contracts (LUX7xx)
+    python tools/luxlint.py --memory f.py    # check fixture modules' TARGETS/
+                                             #   MODELS/MEMCAP instead
     python tools/luxlint.py --baseline F     # snapshot/compare: only findings
                                              #   absent from F fail the run
 
@@ -224,6 +230,29 @@ def _run_programs(paths, select: str, gascap_out: str):
                                  capmap_out=gascap_out or None)
 
 
+def _run_memory(paths, select: str, memcap_out: str):
+    """Memory tier: walk buffer liveness over every traced registry
+    target (LUX701-706) and keep the memcap.v1 footprint artifact
+    honest. Needs the same 8-virtual-device CPU mesh as --ir so the
+    sharded executors have devices to shard over. With paths, fixture
+    modules supply TARGETS/MODELS/MEMCAP/COMMITTED instead (memcap-out
+    is registry-only: fixtures prove rules, they don't price serving)."""
+    from lux_tpu.utils.platform import virtual_cpu_flags
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        virtual_cpu_flags(8) + " --xla_backend_optimization_level=0")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lux_tpu.analysis import memck
+
+    want = None
+    if select:
+        want = tuple(s.strip() for s in select.split(",") if s.strip())
+    if paths:
+        return memck.verify_fixture_paths(paths, select=want)
+    return memck.verify_registry(select=want,
+                                 memcap_out=memcap_out or None)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="luxlint", description=__doc__)
     ap.add_argument("paths", nargs="*",
@@ -269,6 +298,18 @@ def main(argv=None) -> int:
                     help="with --programs (registry mode): write the "
                          "derived gascap.v1 capability artifact here when "
                          "the run is clean")
+    ap.add_argument("--memory", action="store_true",
+                    help="run the memory tier (LUX701-706): donation-aware "
+                         "buffer-liveness walk over every traced registry "
+                         "target deriving per-device peak live bytes and "
+                         "the closed-form footprint model serving admission "
+                         "trusts; with paths, check fixture modules' "
+                         "TARGETS/MODELS/MEMCAP instead")
+    ap.add_argument("--memcap-out", default="", metavar="FILE",
+                    help="with --memory (registry mode): write the derived "
+                         "memcap.v1 footprint artifact here when the run "
+                         "is clean (committed-artifact rules are skipped "
+                         "on a regeneration run)")
     ap.add_argument("--changed", action="store_true",
                     help="AST/threads tiers: restrict to .py files changed "
                          "vs git HEAD (plus untracked); the threads tier "
@@ -280,9 +321,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if sum((args.ir, args.plans, args.threads, args.exchange,
-            args.tune, args.programs)) > 1:
-        ap.error("--ir, --plans, --threads, --exchange, --tune, and "
-                 "--programs are separate tiers; run them separately")
+            args.tune, args.programs, args.memory)) > 1:
+        ap.error("--ir, --plans, --threads, --exchange, --tune, "
+                 "--programs, and --memory are separate tiers; run them "
+                 "separately")
 
     if args.list_rules:
         for r in all_rules():
@@ -315,10 +357,18 @@ def main(argv=None) -> int:
                 print(f"{r.id}  {r.title}\n       {r.doc}")
         except Exception:
             pass
+        try:
+            from lux_tpu.analysis import memck
+            for r in memck.all_memory_rules():
+                print(f"{r.id}  {r.title}\n       {r.doc}")
+        except Exception:
+            pass
         print("LUX101-105  jaxpr tier (dtype drift, host callbacks, "
               "footprint, donation, collectives) — run with --ir")
         print("LUX404-406  exchange dataflow tier (overlap proof, sentinel "
               "annihilation, byte accounting) — run with --exchange")
+        print("LUX701-706  memory tier (HBM-footprint contracts + the "
+              "memcap.v1 serving admission formula) — run with --memory")
         return 0
 
     if args.ir:
@@ -369,6 +419,25 @@ def main(argv=None) -> int:
                     sort_keys=True))
                 return 0
         report = _run_programs(args.paths, args.select, args.gascap_out)
+    elif args.memory:
+        if args.changed and not args.paths:
+            # The tier prices live engine residency, not file text: skip
+            # it unless a footprint-relevant source file changed.
+            relevant = ("lux_tpu/engine/", "lux_tpu/analysis/",
+                        "lux_tpu/serve/", "lux_tpu/obs/",
+                        "lux_tpu/models", "lux_tpu/graph/",
+                        "lux_tpu/parallel/")
+            changed = [p for p in _changed_paths()
+                       if os.path.relpath(p, _REPO).startswith(relevant)]
+            if not changed:
+                print("luxlint: --changed: no memory-relevant files "
+                      "modified")
+                print("LUXLINT " + json.dumps(
+                    {"schema": "luxlint-memory.v1", "files": 0,
+                     "findings": 0, "errors": 0, "ok": True},
+                    sort_keys=True))
+                return 0
+        report = _run_memory(args.paths, args.select, args.memcap_out)
     elif args.threads:
         select = None
         if args.select:
